@@ -1,0 +1,11 @@
+"""jepsen_tpu: a TPU-native distributed-systems testing framework.
+
+Capabilities mirror Jepsen (reference at /root/reference): black-box testing
+of distributed systems via concurrent client operations, fault injection, and
+formal consistency checking of the recorded history. The linearizability
+engine is re-architected for JAX/XLA: dense history tensors, vmapped model
+step functions, and a batched Wing-Gong-Lowe branch-and-bound that runs
+under jit on TPU (see jepsen_tpu.checker.jax_wgl).
+"""
+
+__version__ = "0.1.0"

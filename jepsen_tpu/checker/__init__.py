@@ -1,0 +1,10 @@
+"""Checkers: history -> verdict maps (reference jepsen/src/jepsen/checker.clj).
+
+Core protocol and combinators live in checker.core; the linearizability
+engines in checker.wgl (CPU oracle) and checker.jax_wgl (batched TPU search).
+"""
+
+from .core import *  # noqa: F401,F403
+from .core import __all__ as _core_all
+
+__all__ = list(_core_all)

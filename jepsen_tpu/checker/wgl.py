@@ -1,0 +1,137 @@
+"""Sequential Wing-Gong-Lowe linearizability search (CPU oracle).
+
+This is the exact reference implementation the batched TPU engine
+(jax_wgl.py) is differential-tested against. It reconstructs the algorithm
+knossos.wgl implements (knossos is an external dependency of the reference,
+jepsen/project.clj:14, dispatched from jepsen/src/jepsen/checker.clj:199-202;
+see SURVEY.md section 2.9) from its published description: depth-first search
+over linearization orders with memoized (linearized-bitset, model-state)
+configurations.
+
+Given operations sorted by invocation index, with return index INF_TIME for
+indeterminate (:info) ops:
+
+* a configuration is (bitset of linearized ops, model state);
+* op X may be linearized next iff X is unlinearized and
+  invoke(X) < min{return(Y) : Y unlinearized} -- i.e. X is concurrent with
+  or precedes every other pending op (real-time order is respected);
+* the model step must accept X (not Inconsistent);
+* the history is linearizable iff some reachable configuration has all
+  :ok ops linearized (:info ops may linearize or silently never happen;
+  :fail ops were dropped at encoding).
+
+The search runs directly on the dense tensor encoding, using the same
+branch-free model step as the device path (models.base.ModelSpec.step with
+xp=numpy), so the two engines share transition semantics by construction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..history import INF_TIME
+
+
+def check_encoded(spec, e, init_state, max_configs=None):
+    """Run the WGL search over an EncodedHistory ``e`` with ``init_state``.
+
+    Returns a result dict:
+      valid: True | False
+      configs_explored: number of distinct configurations visited
+      op / final_ops: on failure, the op(s) the search got stuck before
+        (row indices into e, decoded into event dicts when e.ops is set).
+    """
+    n = len(e)
+    invoke = e.invoke_idx
+    ret_t = e.return_idx
+    is_ok = e.is_ok
+    full = (1 << n) - 1
+    ok_mask = 0
+    for i in range(n):
+        if is_ok[i]:
+            ok_mask |= 1 << i
+
+    if ok_mask == 0:
+        return {"valid": True, "configs_explored": 0}
+
+    step = spec.step
+    f = e.f
+    args = e.args
+    rets = e.ret
+
+    init_key = (0, init_state.tobytes())
+    seen = {init_key}
+    stack = [(0, init_state)]
+    explored = 0
+    # Track the deepest stuck frontier for the witness: configs maximizing
+    # the number of linearized ok ops.
+    best_depth = -1
+    best_configs = []
+
+    while stack:
+        lin, state = stack.pop()
+        explored += 1
+        if max_configs is not None and explored > max_configs:
+            return {"valid": "unknown", "configs_explored": explored,
+                    "error": "max-configs-exceeded"}
+        unlin = full & ~lin
+        # minimum return among unlinearized ops
+        r_min = INF_TIME
+        m = unlin
+        while m:
+            i = (m & -m).bit_length() - 1
+            m &= m - 1
+            if ret_t[i] < r_min:
+                r_min = ret_t[i]
+        depth = (lin & ok_mask).bit_count()
+        if depth > best_depth:
+            best_depth = depth
+            best_configs = []
+        progressed = False
+        m = unlin
+        while m:
+            i = (m & -m).bit_length() - 1
+            m &= m - 1
+            if invoke[i] >= r_min:
+                break  # rows sorted by invoke: nothing further qualifies
+            state2, ok = step(state, f[i], args[i], rets[i], np)
+            if not bool(ok):
+                continue
+            state2 = np.asarray(state2, np.int32)
+            lin2 = lin | (1 << i)
+            if (lin2 & ok_mask) == ok_mask:
+                return {"valid": True, "configs_explored": explored}
+            key = (lin2, state2.tobytes())
+            if key not in seen:
+                seen.add(key)
+                stack.append((lin2, state2))
+                progressed = True
+        if not progressed and depth == best_depth and len(best_configs) < 8:
+            best_configs.append((lin, state))
+
+    # exhausted: not linearizable; decode a witness
+    result = {"valid": False, "configs_explored": explored}
+    witnesses = []
+    for lin, state in best_configs:
+        unlin = full & ~lin & ok_mask
+        if unlin:
+            i = (unlin & -unlin).bit_length() - 1
+            witnesses.append({"row": i, "state": state.tolist(),
+                              "op": _decode_op(e, i)})
+    if witnesses:
+        result["op"] = witnesses[0]["op"]
+        result["final_ops"] = witnesses
+    return result
+
+
+def _decode_op(e, i):
+    if e.ops is not None and i < len(e.ops):
+        inv, comp = e.ops[i]
+        return dict(comp if comp is not None else inv)
+    return {"row": int(i)}
+
+
+def check_history(spec, history, **kw):
+    """Encode ``history`` (event dicts) for ``spec`` and run the search."""
+    e, init_state = spec.encode(history)
+    return check_encoded(spec, e, init_state, **kw)

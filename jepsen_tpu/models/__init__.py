@@ -1,0 +1,45 @@
+"""Consistency models: knossos.model equivalents with both Python oracle and
+JAX tensor faces. See base.py for the design."""
+
+from .base import (Inconsistent, Interner, Model, ModelSpec, inconsistent,
+                   is_inconsistent, known_models, model_spec, register_model)
+from .registers import (CASRegister, MultiRegister, Register,
+                        cas_register_spec, multi_register_spec, register_spec)
+from .mutex import Mutex, mutex_spec
+from .queues import (FIFOQueue, UnorderedQueue, fifo_queue_spec,
+                     unordered_queue_spec)
+
+# knossos.model constructor-style aliases
+def register(value=None):
+    return Register(value)
+
+
+def cas_register(value=None):
+    return CASRegister(value)
+
+
+def mutex():
+    return Mutex()
+
+
+def fifo_queue(*items):
+    return FIFOQueue(items)
+
+
+def unordered_queue(*items):
+    return UnorderedQueue(items)
+
+
+def multi_register(values=None):
+    return MultiRegister(values)
+
+
+__all__ = [
+    "Inconsistent", "Interner", "Model", "ModelSpec", "inconsistent",
+    "is_inconsistent", "known_models", "model_spec", "register_model",
+    "CASRegister", "MultiRegister", "Register", "Mutex", "FIFOQueue",
+    "UnorderedQueue", "register_spec", "cas_register_spec",
+    "multi_register_spec", "mutex_spec", "fifo_queue_spec",
+    "unordered_queue_spec", "register", "cas_register", "mutex",
+    "fifo_queue", "unordered_queue", "multi_register",
+]

@@ -1,0 +1,151 @@
+"""Consistency models.
+
+Two faces of the same model, differential-tested against each other:
+
+1. **Oracle face** (knossos.model surface, reference checker.clj:233-234,
+   jepsen/src/jepsen/tests.clj:8): immutable Python objects with
+   ``step(op) -> model' | Inconsistent``. Used by host-side checkers
+   (queue checker's model fold) and as the ground truth in tests.
+
+2. **Tensor face** (the TPU path): a ``ModelSpec`` describing a fixed-width
+   int32 state vector and a *branch-free* transition
+   ``step(state, f, args, ret, xp) -> (state', ok)`` written against an
+   array namespace ``xp`` -- the same code runs eagerly under numpy (the
+   sequential WGL oracle) and vmapped under jax.numpy on device (the
+   batched B&B frontier expansion). Branch-free means where/one-hot only:
+   no data-dependent Python control flow, so XLA traces it once.
+
+Value encoding: history values must become int32. Integers pass through;
+other hashables are interned per-encoding via Interner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import numpy as np
+
+from ..history import NIL, encode_history
+
+
+class Inconsistent:
+    """Marker for an invalid transition (knossos.model/inconsistent)."""
+
+    def __init__(self, msg=""):
+        self.msg = msg
+
+    def __repr__(self):
+        return f"Inconsistent({self.msg!r})"
+
+    def __bool__(self):
+        return False
+
+
+def inconsistent(msg=""):
+    return Inconsistent(msg)
+
+
+def is_inconsistent(x) -> bool:
+    return isinstance(x, Inconsistent)
+
+
+class Model:
+    """Immutable state machine: ``step(op) -> Model | Inconsistent``."""
+
+    def step(self, op):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class Interner:
+    """Maps arbitrary hashable values to dense non-negative int32 codes.
+    Integers that fit int32 map to themselves (so arithmetic-flavored tests
+    stay readable); everything else is interned."""
+
+    INT_LO = -(2**30)
+    INT_HI = 2**30
+
+    def __init__(self):
+        self._codes = {}
+        self._next = 2**30  # interned codes live above the passthrough range
+
+    def encode(self, v):
+        if v is None:
+            return NIL
+        if isinstance(v, bool):
+            return int(v)
+        if isinstance(v, (int, np.integer)) and self.INT_LO < v < self.INT_HI:
+            return int(v)
+        code = self._codes.get(v)
+        if code is None:
+            code = self._next
+            self._next += 1
+            self._codes[v] = code
+        return code
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """Tensor-face description of a model (see module docstring).
+
+    Attributes:
+      name: model name (matches the oracle class).
+      f_codes: map op-f (str) -> int code.
+      arg_width: A, width of the args/ret vectors.
+      state_size: fn(EncodedHistory) -> S, the int32 state-vector length
+        (history-dependent for queues: capacity = #enqueues).
+      init_state: fn(EncodedHistory, S) -> np.int32[S].
+      step: fn(state, f, args, ret, xp) -> (state', ok). All arrays from
+        namespace xp; state (S,), f scalar, args/ret (A,), ok scalar bool.
+      make_oracle: fn() -> Model for the same initial state.
+    """
+
+    name: str
+    f_codes: dict
+    arg_width: int
+    state_size: Callable
+    init_state: Callable
+    step: Callable
+    make_oracle: Callable
+    # encode one op: (f, invoke_value, completion_value|None)
+    #   -> (fcode, args_list, ret_list)
+    encode_op: Callable = None
+
+    def encode(self, hist):
+        """Encode an event history for this model. Returns (EncodedHistory,
+        init_state np.int32[S])."""
+        interner = Interner()
+        enc = self.encode_op or self.default_encode_op
+        e = encode_history(hist, lambda f, v, rv: enc(self, interner, f, v, rv),
+                           self.arg_width)
+        s = self.state_size(e)
+        return e, np.asarray(self.init_state(e, s), np.int32)
+
+    @staticmethod
+    def default_encode_op(spec, interner, f, value, ret_value):
+        """Default encoder: f by f_codes; invoke value -> args[0];
+        completion value -> ret[0]."""
+        fcode = spec.f_codes[f]
+        return fcode, [interner.encode(value)], [interner.encode(ret_value)]
+
+
+_REGISTRY = {}
+
+
+def register_model(spec: ModelSpec):
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def model_spec(name_or_spec) -> ModelSpec:
+    if isinstance(name_or_spec, ModelSpec):
+        return name_or_spec
+    try:
+        return _REGISTRY[name_or_spec]
+    except KeyError:
+        raise KeyError(f"Unknown model {name_or_spec!r}; known: "
+                       f"{sorted(_REGISTRY)}") from None
+
+
+def known_models():
+    return dict(_REGISTRY)

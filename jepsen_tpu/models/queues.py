@@ -1,0 +1,167 @@
+"""Queue models (knossos.model fifo-queue / unordered-queue).
+
+The tensor face uses *canonical* fixed-capacity buffers so that equal queue
+contents always produce byte-equal state vectors -- this is what makes the
+checker's configuration dedup effective (SURVEY.md section 7 "unbounded model
+state under vmap"):
+
+* fifo-queue: left-aligned ring -- the front is always slot 0; dequeue
+  shifts the whole buffer left (one vectorized roll, no head pointer).
+* unordered-queue: a multiset kept sorted ascending with empties (NIL,
+  int32 min) first.
+
+Capacity is chosen from the history: the number of enqueue operations
+(worst case all enqueued before any dequeue). Overflow cannot occur under
+that choice, but the ok-flag still guards it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..history import NIL
+from .base import Model, ModelSpec, inconsistent, register_model
+
+F_ENQUEUE, F_DEQUEUE = 0, 1
+
+
+class FIFOQueue(Model):
+    def __init__(self, items=()):
+        self.items = tuple(items)
+
+    def step(self, op):
+        f, v = op["f"], op.get("value")
+        if f == "enqueue":
+            return FIFOQueue(self.items + (v,))
+        if f == "dequeue":
+            if not self.items:
+                return inconsistent("dequeue from empty queue")
+            head, rest = self.items[0], self.items[1:]
+            if v is not None and v != head:
+                return inconsistent(f"dequeued {v!r}, expected {head!r}")
+            return FIFOQueue(rest)
+        raise ValueError(f"fifo-queue: unknown f {f!r}")
+
+    def __eq__(self, other):
+        return isinstance(other, FIFOQueue) and self.items == other.items
+
+    def __hash__(self):
+        return hash(("fifo-queue", self.items))
+
+    def __repr__(self):
+        return f"FIFOQueue({list(self.items)!r})"
+
+
+class UnorderedQueue(Model):
+    """A multiset: dequeue may return any enqueued element
+    (knossos.model/unordered-queue). A dequeue of unknown value cannot be
+    linearized (mirrors knossos, whose step sees a nil value)."""
+
+    def __init__(self, items=()):
+        self.items = tuple(sorted(items))
+
+    def step(self, op):
+        f, v = op["f"], op.get("value")
+        if f == "enqueue":
+            return UnorderedQueue(self.items + (v,))
+        if f == "dequeue":
+            if v is None:
+                return inconsistent("dequeue of unknown value")
+            if v not in self.items:
+                return inconsistent(f"dequeued {v!r}, not in queue")
+            items = list(self.items)
+            items.remove(v)
+            return UnorderedQueue(items)
+        raise ValueError(f"unordered-queue: unknown f {f!r}")
+
+    def __eq__(self, other):
+        return isinstance(other, UnorderedQueue) and self.items == other.items
+
+    def __hash__(self):
+        return hash(("unordered-queue", self.items))
+
+    def __repr__(self):
+        return f"UnorderedQueue({list(self.items)!r})"
+
+
+# -- tensor specs ------------------------------------------------------------
+
+def _queue_capacity(e):
+    return max(1, int((e.f == F_ENQUEUE).sum()))
+
+
+def _fifo_step(state, f, args, ret, xp):
+    # state = [count, buf[0..C-1]]; front at buf[0]
+    C = state.shape[0] - 1
+    count = state[0]
+    buf = state[1:]
+    idxs = xp.arange(C)
+    is_enq = f == F_ENQUEUE
+    # enqueue appends at index `count`
+    enq_buf = xp.where(idxs == count, args[0], buf)
+    enq_ok = count < C
+    # dequeue pops buf[0], shifting left; last slot becomes empty
+    front = buf[0]
+    nonempty = count > 0
+    deq_ok = nonempty & ((ret[0] == NIL) | (ret[0] == front))
+    deq_buf = xp.where(idxs == C - 1, NIL, xp.roll(buf, -1))
+    new_count = xp.where(is_enq, count + 1, count - 1).astype(state.dtype)
+    new_buf = xp.where(is_enq, enq_buf, deq_buf)
+    ok = xp.where(is_enq, enq_ok, deq_ok)
+    return xp.concatenate([new_count[None], new_buf]), ok
+
+
+def _queue_encode(spec, intern, f, value, ret_value):
+    if f == "enqueue":
+        return F_ENQUEUE, [intern.encode(value)], []
+    if f == "dequeue":
+        rv = ret_value if ret_value is not None else value
+        return F_DEQUEUE, [], [intern.encode(rv)]
+    raise ValueError(f"queue: unknown f {f!r}")
+
+
+fifo_queue_spec = register_model(ModelSpec(
+    name="fifo-queue",
+    f_codes={"enqueue": F_ENQUEUE, "dequeue": F_DEQUEUE},
+    arg_width=1,
+    state_size=lambda e: _queue_capacity(e) + 1,
+    init_state=lambda e, s: np.concatenate(
+        [np.zeros(1, np.int32), np.full(s - 1, NIL, np.int32)]),
+    step=_fifo_step,
+    make_oracle=FIFOQueue,
+    encode_op=_queue_encode,
+))
+
+
+def _unordered_step(state, f, args, ret, xp):
+    # state = sorted multiset; NIL (int32 min) slots sort first = empty
+    C = state.shape[0]
+    idxs = xp.arange(C)
+    is_enq = f == F_ENQUEUE
+    # enqueue: overwrite the first empty slot
+    empty = state == NIL
+    first_empty = xp.argmax(empty)
+    enq_buf = xp.where(idxs == first_empty, args[0], state)
+    enq_ok = xp.any(empty)
+    # dequeue: clear the first slot equal to ret (value must be known)
+    known = ret[0] != NIL
+    match = state == ret[0]
+    exists = xp.any(match)
+    first_match = xp.argmax(match)
+    deq_buf = xp.where(idxs == first_match, NIL, state)
+    deq_ok = known & exists
+    new_buf = xp.where(is_enq, enq_buf, deq_buf)
+    ok = xp.where(is_enq, enq_ok, deq_ok)
+    return xp.sort(new_buf), ok
+
+
+unordered_queue_spec = register_model(ModelSpec(
+    name="unordered-queue",
+    f_codes={"enqueue": F_ENQUEUE, "dequeue": F_DEQUEUE},
+    arg_width=1,
+    state_size=_queue_capacity,
+    init_state=lambda e, s: np.full(s, NIL, np.int32),
+    step=_unordered_step,
+    make_oracle=UnorderedQueue,
+    encode_op=_queue_encode,
+))

@@ -1,0 +1,160 @@
+"""Cross-cutting utilities (reference: jepsen/src/jepsen/util.clj).
+
+Relative-time clock (util.clj:328-347), majority math (util.clj:84-93),
+parallel map with real exceptions (real-pmap, util.clj:65-77), timeouts
+(util.clj:~370-381), and history pretty-printing (util.clj:177-238).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import contextlib
+import random
+import threading
+import time as _time
+
+MICRO = 1_000
+MILLI = 1_000_000
+SECOND = 1_000_000_000
+
+_relative_origin = threading.local()
+_global_origin = None
+
+
+@contextlib.contextmanager
+def with_relative_time():
+    """Establish t=0 for relative_time_nanos (util.clj:328-347). The origin is
+    global (all worker threads share it), mirroring the reference's var."""
+    global _global_origin
+    prev = _global_origin
+    _global_origin = _time.monotonic_ns()
+    try:
+        yield
+    finally:
+        _global_origin = prev
+
+
+def relative_time_nanos() -> int:
+    origin = _global_origin
+    if origin is None:
+        raise RuntimeError("No relative time origin: use with_relative_time()")
+    return _time.monotonic_ns() - origin
+
+
+def majority(n: int) -> int:
+    """Smallest integer strictly greater than half (util.clj:84-88)."""
+    return n // 2 + 1
+
+
+def minority(n: int) -> int:
+    """Largest integer strictly less than half (util.clj:90-93)."""
+    return (n - 1) // 2
+
+
+def minority_third(n: int) -> int:
+    """Largest m such that 3m < n: a minority small enough that the other
+    two-thirds retain quorum (nemesis/combined.clj :minority-third
+    targeting)."""
+    return max(0, (n - 1) // 3)
+
+
+def real_pmap(f, coll):
+    """Map f over coll in parallel, one thread per element; raises the first
+    exception raised by any element (util.clj:65-77 via dom-top)."""
+    coll = list(coll)
+    if not coll:
+        return []
+    with concurrent.futures.ThreadPoolExecutor(max_workers=len(coll)) as ex:
+        futures = [ex.submit(f, x) for x in coll]
+        results = []
+        first_err = None
+        for fut in futures:
+            try:
+                results.append(fut.result())
+            except BaseException as e:  # noqa: BLE001 - propagate first error
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        return results
+
+
+def bounded_pmap(f, coll, bound=None):
+    """Parallel map with a bounded worker pool (dom-top bounded-pmap,
+    used by independent.clj:285)."""
+    coll = list(coll)
+    if not coll:
+        return []
+    bound = bound or min(32, len(coll))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=bound) as ex:
+        return list(ex.map(f, coll))
+
+
+class Timeout(Exception):
+    pass
+
+
+def timeout_call(ms, timeout_val, f, *args):
+    """Run f in a thread; if it exceeds ms milliseconds return timeout_val
+    (the thread is abandoned, like the reference's future cancellation --
+    util.clj timeout macro)."""
+    ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    fut = ex.submit(f, *args)
+    try:
+        return fut.result(timeout=ms / 1000.0)
+    except concurrent.futures.TimeoutError:
+        fut.cancel()
+        return timeout_val
+    finally:
+        ex.shutdown(wait=False)
+
+
+def rand_nth(seq, rng=random):
+    return seq[rng.randrange(len(seq))]
+
+
+def rand_exp(rng=random):
+    return rng.expovariate(1.0)
+
+
+def fraction(a, b):
+    return a / b if b else 0.0
+
+
+def nanos_to_secs(ns):
+    return ns / SECOND
+
+
+def secs_to_nanos(s):
+    return int(s * SECOND)
+
+
+def ms_to_nanos(ms):
+    return int(ms * MILLI)
+
+
+def longest_common_prefix(strings):
+    if not strings:
+        return ""
+    s1, s2 = min(strings), max(strings)
+    for i, c in enumerate(s1):
+        if c != s2[i]:
+            return s1[:i]
+    return s1
+
+
+def op_str(o) -> str:
+    """Render an op like the reference's history printer (util.clj:177-238):
+    ``process  type  f  value [error]``."""
+    parts = [str(o.get("process")), str(o.get("type")), str(o.get("f")),
+             repr(o.get("value"))]
+    if o.get("error") is not None:
+        parts.append(repr(o["error"]))
+    return "\t".join(parts)
+
+
+def print_history(history, out=None):
+    import sys
+    out = out or sys.stdout
+    for o in history:
+        out.write(op_str(o) + "\n")

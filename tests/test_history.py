@@ -1,0 +1,83 @@
+import numpy as np
+
+from jepsen_tpu import history as h
+from jepsen_tpu.models import cas_register_spec, register_spec
+
+
+def test_op_attr_access():
+    o = h.op("invoke", 0, "read", None)
+    assert o.type == "invoke"
+    assert o.process == 0
+    assert o["f"] == "read"
+    o2 = o.assoc(type="ok", value=3)
+    assert o2.type == "ok" and o2.value == 3
+    assert o.type == "invoke"  # original untouched
+
+
+def test_index():
+    hist = [h.invoke_op(0, "read"), h.ok_op(0, "read", 1)]
+    idx = h.index(hist)
+    assert [o["index"] for o in idx] == [0, 1]
+
+
+def test_pairs():
+    hist = h.index([
+        h.invoke_op(0, "read"),
+        h.invoke_op(1, "write", 3),
+        h.ok_op(1, "write", 3),
+        h.ok_op(0, "read", 3),
+        h.invoke_op(2, "read"),  # never completes
+    ])
+    ps = h.pairs(hist)
+    assert len(ps) == 3
+    assert ps[0][0]["process"] == 1 and ps[0][1]["type"] == "ok"
+    assert ps[1][0]["process"] == 0
+    assert ps[2] == (hist[4], None)
+
+
+def test_complete_fills_read_values():
+    hist = h.index([
+        h.invoke_op(0, "read", None),
+        h.ok_op(0, "read", 5),
+    ])
+    c = h.complete(hist)
+    assert c[0]["value"] == 5
+
+
+def test_encode_drops_fails_and_marks_info():
+    hist = h.index([
+        h.invoke_op(0, "write", 1),
+        h.invoke_op(1, "write", 2),
+        h.fail_op(1, "write", 2),
+        h.ok_op(0, "write", 1),
+        h.invoke_op(2, "write", 3),
+        h.info_op(2, "write", 3),
+    ])
+    e, s0 = register_spec.encode(hist)
+    assert len(e) == 2  # fail dropped
+    assert e.n_ok == 1
+    # info op has infinite return
+    info_row = int(np.argmax(~e.is_ok))
+    assert e.return_idx[info_row] == h.INF_TIME
+    assert s0.tolist() == [h.NIL]
+
+
+def test_encode_sorted_by_invoke():
+    hist = h.index([
+        h.invoke_op(0, "write", 1),
+        h.invoke_op(1, "read", None),
+        h.ok_op(0, "write", 1),
+        h.ok_op(1, "read", 1),
+    ])
+    e, _ = cas_register_spec.encode(hist)
+    assert list(e.invoke_idx) == sorted(e.invoke_idx)
+    assert len(e) == 2
+
+
+def test_parse_compact():
+    hist = h.parse_history_edn_like([
+        ("invoke", 0, "write", 1),
+        ("ok", 0, "write", 1),
+    ])
+    assert hist[0]["index"] == 0
+    assert hist[1]["type"] == "ok"

@@ -1,0 +1,118 @@
+"""Model tests: oracle semantics + numpy/jax step equivalence."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jepsen_tpu.history import NIL
+from jepsen_tpu import models as m
+
+
+# -- oracle semantics --------------------------------------------------------
+
+def test_register_oracle():
+    r = m.register()
+    r = r.step({"f": "write", "value": 3})
+    assert r == m.register(3)
+    assert r.step({"f": "read", "value": 3}) == r
+    assert r.step({"f": "read", "value": None}) == r
+    assert m.is_inconsistent(r.step({"f": "read", "value": 4}))
+
+
+def test_cas_register_oracle():
+    r = m.cas_register(1)
+    r2 = r.step({"f": "cas", "value": [1, 2]})
+    assert r2 == m.cas_register(2)
+    assert m.is_inconsistent(r.step({"f": "cas", "value": [3, 4]}))
+
+
+def test_mutex_oracle():
+    x = m.mutex()
+    x2 = x.step({"f": "acquire"})
+    assert x2.locked
+    assert m.is_inconsistent(x2.step({"f": "acquire"}))
+    assert not x2.step({"f": "release"}).locked
+    assert m.is_inconsistent(x.step({"f": "release"}))
+
+
+def test_fifo_queue_oracle():
+    q = m.fifo_queue()
+    q = q.step({"f": "enqueue", "value": 1}).step({"f": "enqueue", "value": 2})
+    assert m.is_inconsistent(q.step({"f": "dequeue", "value": 2}))
+    q2 = q.step({"f": "dequeue", "value": 1})
+    assert q2 == m.fifo_queue(2)
+    assert m.is_inconsistent(m.fifo_queue().step({"f": "dequeue", "value": 1}))
+
+
+def test_unordered_queue_oracle():
+    q = m.unordered_queue()
+    q = q.step({"f": "enqueue", "value": 1}).step({"f": "enqueue", "value": 2})
+    q2 = q.step({"f": "dequeue", "value": 2})
+    assert q2 == m.unordered_queue(1)
+    assert m.is_inconsistent(q.step({"f": "dequeue", "value": 9}))
+
+
+def test_multi_register_oracle():
+    r = m.multi_register({"x": 1, "y": 2})
+    r2 = r.step({"f": "write", "value": {"x": 5}})
+    assert r2.values == {"x": 5, "y": 2}
+    assert r2.step({"f": "read", "value": {"x": 5, "y": 2}}) == r2
+    assert m.is_inconsistent(r2.step({"f": "read", "value": {"x": 1}}))
+
+
+# -- numpy/jax step equivalence ----------------------------------------------
+
+def _random_args(rng, spec, s0):
+    f = rng.integers(0, len(spec.f_codes))
+    args = rng.integers(-2, 4, size=spec.arg_width).astype(np.int32)
+    ret = rng.integers(-2, 4, size=spec.arg_width).astype(np.int32)
+    # sprinkle NILs
+    args[rng.random(spec.arg_width) < 0.3] = NIL
+    ret[rng.random(spec.arg_width) < 0.3] = NIL
+    return np.int32(f), args, ret
+
+
+@pytest.mark.parametrize("spec_name", [
+    "register", "cas-register", "mutex", "fifo-queue", "unordered-queue"])
+def test_step_np_jax_equivalence(spec_name):
+    spec = m.model_spec(spec_name)
+    S = 6 if "queue" in spec_name else spec.state_size(None)
+
+    class FakeEnc:
+        f = np.array([0] * 5, np.int32)  # 5 enqueues worth of capacity
+
+    if "queue" in spec_name:
+        s0 = spec.init_state(FakeEnc(), S)
+    else:
+        s0 = spec.init_state(None, S)
+    s0 = np.asarray(s0, np.int32)
+
+    jstep = jax.jit(lambda s, f, a, r: spec.step(s, f, a, r, jnp))
+    rng = np.random.default_rng(7)
+    state = s0
+    for _ in range(200):
+        f, args, ret = _random_args(rng, spec, state)
+        ns_np, ok_np = spec.step(state, f, args, ret, np)
+        ns_j, ok_j = jstep(state, f, args, ret)
+        assert bool(ok_np) == bool(ok_j), (spec_name, f, args, ret, state)
+        np.testing.assert_array_equal(np.asarray(ns_np), np.asarray(ns_j))
+        if bool(ok_np):
+            state = np.asarray(ns_np, np.int32)
+
+
+def test_register_tensor_matches_oracle():
+    spec = m.register_spec
+    s0 = np.full(1, NIL, np.int32)
+    ns, ok = spec.step(s0, np.int32(1), np.array([7], np.int32),
+                       np.array([NIL], np.int32), np)
+    assert bool(ok) and ns[0] == 7
+    # read of wrong value fails
+    _, ok = spec.step(ns, np.int32(0), np.array([NIL], np.int32),
+                      np.array([8], np.int32), np)
+    assert not bool(ok)
+    # read of NIL (unknown) is ok
+    _, ok = spec.step(ns, np.int32(0), np.array([NIL], np.int32),
+                      np.array([NIL], np.int32), np)
+    assert bool(ok)

@@ -1,0 +1,232 @@
+"""WGL oracle tests: golden histories + randomized cross-validation against
+brute-force permutation search."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import history as h
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import wgl
+from jepsen_tpu.history import INF_TIME
+
+
+def H(*rows):
+    return h.parse_history_edn_like(rows)
+
+
+# -- golden histories --------------------------------------------------------
+
+def test_empty_history_valid():
+    r = wgl.check_history(m.register_spec, [])
+    assert r["valid"] is True
+
+
+def test_sequential_register_valid():
+    hist = H(("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+             ("invoke", 0, "read", None), ("ok", 0, "read", 1))
+    assert wgl.check_history(m.register_spec, hist)["valid"] is True
+
+
+def test_stale_read_invalid():
+    hist = H(("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+             ("invoke", 0, "write", 2), ("ok", 0, "write", 2),
+             ("invoke", 0, "read", None), ("ok", 0, "read", 1))
+    r = wgl.check_history(m.register_spec, hist)
+    assert r["valid"] is False
+    assert r["op"]["f"] == "read"
+
+
+def test_concurrent_reads_both_values_valid():
+    # w(1) concurrent with r->nil and r->1: both orderings exist
+    hist = H(("invoke", 0, "write", 1),
+             ("invoke", 1, "read", None),
+             ("ok", 1, "read", None),
+             ("invoke", 2, "read", None),
+             ("ok", 2, "read", 1),
+             ("ok", 0, "write", 1))
+    assert wgl.check_history(m.register_spec, hist)["valid"] is True
+
+
+def test_cas_classic_valid():
+    hist = H(("invoke", 0, "write", 0), ("ok", 0, "write", 0),
+             ("invoke", 1, "cas", [0, 1]),
+             ("invoke", 2, "cas", [0, 2]),
+             ("ok", 1, "cas", [0, 1]),
+             ("fail", 2, "cas", [0, 2]),
+             ("invoke", 0, "read", None), ("ok", 0, "read", 1))
+    assert wgl.check_history(m.cas_register_spec, hist)["valid"] is True
+
+
+def test_cas_both_succeed_same_old_invalid():
+    hist = H(("invoke", 0, "write", 0), ("ok", 0, "write", 0),
+             ("invoke", 1, "cas", [0, 1]), ("ok", 1, "cas", [0, 1]),
+             ("invoke", 2, "cas", [0, 2]), ("ok", 2, "cas", [0, 2]))
+    assert wgl.check_history(m.cas_register_spec, hist)["valid"] is False
+
+
+def test_info_write_may_have_happened():
+    # a timed-out write must be assumed possible: later read of its value ok
+    hist = H(("invoke", 0, "write", 3), ("info", 0, "write", 3),
+             ("invoke", 1, "read", None), ("ok", 1, "read", 3))
+    assert wgl.check_history(m.register_spec, hist)["valid"] is True
+
+
+def test_info_write_may_not_have_happened():
+    hist = H(("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+             ("invoke", 0, "write", 3), ("info", 0, "write", 3),
+             ("invoke", 1, "read", None), ("ok", 1, "read", 1))
+    assert wgl.check_history(m.register_spec, hist)["valid"] is True
+
+
+def test_info_op_stays_concurrent_forever():
+    # crashed write can linearize arbitrarily late
+    hist = H(("invoke", 0, "write", 3), ("info", 0, "write", 3),
+             ("invoke", 1, "write", 5), ("ok", 1, "write", 5),
+             ("invoke", 1, "read", None), ("ok", 1, "read", 5),
+             ("invoke", 1, "read", None), ("ok", 1, "read", 3))
+    assert wgl.check_history(m.register_spec, hist)["valid"] is True
+
+
+def test_realtime_order_enforced():
+    # w(1) completes before w(2) invokes; read of 1 after w(2) ok is stale
+    hist = H(("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+             ("invoke", 1, "write", 2), ("ok", 1, "write", 2),
+             ("invoke", 2, "read", None), ("ok", 2, "read", 1))
+    assert wgl.check_history(m.register_spec, hist)["valid"] is False
+
+
+def test_mutex_double_acquire_invalid():
+    hist = H(("invoke", 0, "acquire", None), ("ok", 0, "acquire", None),
+             ("invoke", 1, "acquire", None), ("ok", 1, "acquire", None))
+    assert wgl.check_history(m.mutex_spec, hist)["valid"] is False
+
+
+def test_mutex_valid_interleaving():
+    hist = H(("invoke", 0, "acquire", None), ("ok", 0, "acquire", None),
+             ("invoke", 0, "release", None), ("ok", 0, "release", None),
+             ("invoke", 1, "acquire", None), ("ok", 1, "acquire", None))
+    assert wgl.check_history(m.mutex_spec, hist)["valid"] is True
+
+
+def test_fifo_queue_order():
+    hist = H(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+             ("invoke", 0, "enqueue", 2), ("ok", 0, "enqueue", 2),
+             ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 2))
+    assert wgl.check_history(m.fifo_queue_spec, hist)["valid"] is False
+    hist2 = H(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+              ("invoke", 0, "enqueue", 2), ("ok", 0, "enqueue", 2),
+              ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1))
+    assert wgl.check_history(m.fifo_queue_spec, hist2)["valid"] is True
+
+
+def test_unordered_queue_any_order():
+    hist = H(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+             ("invoke", 0, "enqueue", 2), ("ok", 0, "enqueue", 2),
+             ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 2))
+    assert wgl.check_history(m.unordered_queue_spec, hist)["valid"] is True
+    bad = H(("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+            ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 7))
+    assert wgl.check_history(m.unordered_queue_spec, bad)["valid"] is False
+
+
+def test_multi_register():
+    spec = m.multi_register_spec(["x", "y"])
+    hist = H(("invoke", 0, "write", {"x": 1, "y": 2}),
+             ("ok", 0, "write", {"x": 1, "y": 2}),
+             ("invoke", 1, "read", None), ("ok", 1, "read", {"x": 1, "y": 2}))
+    assert wgl.check_history(spec, hist)["valid"] is True
+    bad = H(("invoke", 0, "write", {"x": 1, "y": 2}),
+            ("ok", 0, "write", {"x": 1, "y": 2}),
+            ("invoke", 1, "read", None), ("ok", 1, "read", {"x": 1, "y": 9}))
+    assert wgl.check_history(spec, bad)["valid"] is False
+
+
+# -- randomized cross-validation against brute force -------------------------
+
+def brute_force_linearizable(spec, e, init_state):
+    """Try every permutation of ops (and every subset of info ops) that
+    respects real-time order. Exponential: only for tiny histories."""
+    n = len(e)
+    ok_rows = [i for i in range(n) if e.is_ok[i]]
+    info_rows = [i for i in range(n) if not e.is_ok[i]]
+    for r in range(len(info_rows) + 1):
+        for included in itertools.combinations(info_rows, r):
+            rows = sorted(ok_rows + list(included))
+            for perm in itertools.permutations(rows):
+                # real-time: if return(a) < invoke(b), a must precede b
+                pos = {x: i for i, x in enumerate(perm)}
+                if any(e.return_idx[a] < e.invoke_idx[b] and pos[a] > pos[b]
+                       for a in rows for b in rows if a != b):
+                    continue
+                state = init_state
+                good = True
+                for i in perm:
+                    state, ok = spec.step(state, e.f[i], e.args[i], e.ret[i],
+                                          np)
+                    if not bool(ok):
+                        good = False
+                        break
+                    state = np.asarray(state, np.int32)
+                if good:
+                    return True
+    return False
+
+
+def random_history(rng, n_procs=3, n_ops=6, model="cas-register"):
+    """Generate a small random concurrent history of register ops."""
+    hist = []
+    reg = {"val": None}
+    open_procs = {}
+    t = 0
+    procs = list(range(n_procs))
+    ops_left = n_ops
+    while ops_left > 0 or open_procs:
+        can_invoke = [p for p in procs if p not in open_procs] \
+            if ops_left > 0 else []
+        if can_invoke and (not open_procs or rng.random() < 0.5):
+            p = can_invoke[rng.integers(len(can_invoke))]
+            kind = rng.choice(["read", "write", "cas"]) \
+                if model == "cas-register" else rng.choice(["read", "write"])
+            if kind == "write":
+                v = int(rng.integers(0, 3))
+                o = h.invoke_op(p, "write", v)
+            elif kind == "cas":
+                o = h.invoke_op(p, "cas",
+                                [int(rng.integers(0, 3)),
+                                 int(rng.integers(0, 3))])
+            else:
+                o = h.invoke_op(p, "read", None)
+            hist.append(o)
+            open_procs[p] = o
+            ops_left -= 1
+        else:
+            p = list(open_procs)[rng.integers(len(open_procs))]
+            inv = open_procs.pop(p)
+            roll = rng.random()
+            if roll < 0.15:
+                hist.append(h.info_op(p, inv["f"], inv["value"]))
+            elif roll < 0.25 and inv["f"] in ("cas",):
+                hist.append(h.fail_op(p, inv["f"], inv["value"]))
+            else:
+                # produce a completion; value possibly wrong to create
+                # invalid histories
+                if inv["f"] == "read":
+                    v = int(rng.integers(0, 3)) if rng.random() < 0.8 else None
+                    hist.append(h.ok_op(p, "read", v))
+                else:
+                    hist.append(h.ok_op(p, inv["f"], inv["value"]))
+        t += 1
+    return h.index(hist)
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_wgl_matches_brute_force(seed):
+    rng = np.random.default_rng(seed)
+    hist = random_history(rng, n_procs=3, n_ops=5)
+    spec = m.cas_register_spec
+    e, s0 = spec.encode(hist)
+    expected = brute_force_linearizable(spec, e, s0)
+    got = wgl.check_history(spec, hist)
+    assert got["valid"] is expected, hist

@@ -1,0 +1,447 @@
+"""Batched Wing-Gong-Lowe linearizability search on TPU.
+
+This is the TPU-native replacement for the engine the reference outsources to
+knossos (jepsen/project.clj:14, dispatched from jepsen/src/jepsen/checker.clj:
+199-202). The sequential oracle in wgl.py defines the semantics; this module
+runs the same search as a *batched branch-and-bound* entirely on device, one
+``lax.while_loop`` per check (SURVEY.md section 7: "keep the whole B&B loop in
+one lax.while_loop").
+
+Design (everything fixed-shape so XLA traces once):
+
+* A **configuration** is (bitset of linearized ops, model state). Bitsets are
+  ``uint32[B]`` words, B = ceil(n/32); states are ``int32[S]``.
+* The search keeps a DFS **stack** of configurations in HBM
+  (``buf_lin: uint32[O,B]``, ``buf_state: int32[O,S]``, scalar ``top``).
+* Each iteration pops the top ``W`` configs (a *frontier*), expands all of
+  them at once:
+    - unlinearized-op bits are unpacked with a word gather + shift,
+    - the WGL rule (op i may linearize next iff ``invoke[i] < min`` return
+      over unlinearized ops) becomes a masked row-min + compare,
+    - up to ``C`` candidate ops per config are selected with ``top_k``
+      (C is the history's max point-concurrency, a static bound on how many
+      ops can ever be eligible at once),
+    - the model step function is vmapped over (frontier, candidate).
+* **Dedup** uses a device-resident open-addressing hash table of 64-bit
+  fingerprints (two independent 32-bit multiply-shift hashes over the config
+  words). The table is insert-only with linear probing; scatter races between
+  distinct keys are resolved by re-gathering ("landed?") and probing on.
+  Crucially the table is *best-effort in the safe direction*: a failed insert
+  only means the config may be re-explored (children strictly grow the
+  bitset, so the search still terminates). A false "seen" requires a 64-bit
+  fingerprint collision (~2^-64 per pair); invalid verdicts can be confirmed
+  exactly with the sequential oracle via ``confirm=...``.
+* New configs are pushed back on the stack with a cumsum scatter; stack
+  overflow sets a ``dropped`` flag which degrades an "exhausted" verdict to
+  ``unknown`` (success verdicts are unaffected -- dropping work can never
+  manufacture a linearization).
+* The loop ends on: success (a child linearizes every ``ok`` op), exhaustion
+  (stack empty), or budget (iteration cap). Witness for invalid verdicts:
+  the deepest config reached (max linearized-ok count) is tracked on device
+  and decoded on host.
+
+The same compiled search is reused across histories with identical padded
+shapes (shapes are bucketed to powers of two for reuse). The search body is
+pure, so a vmapped variant over a leading key axis (jepsen.independent-style
+multi-key checks) builds on the same kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..history import INF_TIME
+
+INF32 = np.int32(2**31 - 1)
+
+#: linear-probe length for the dedup hash table
+PROBES = 8
+
+
+# ---------------------------------------------------------------------------
+# host-side helpers
+
+def max_point_concurrency(invoke_idx, return_idx):
+    """Static bound C on WGL candidates: the max, over return points t, of
+    |{i : invoke_i < t <= return_i}| (info ops stay open forever). Every
+    candidate set at any reachable configuration is contained in one such
+    interval stab (see module docstring). Single O(n log n) event sweep."""
+    n = len(invoke_idx)
+    if n == 0:
+        return 1
+    finite = return_idx < INF_TIME
+    if not finite.any():
+        return n
+    # +1 just after each invoke, -1 just after each finite return; the open
+    # count sampled at a return point t is |{i: invoke_i < t <= return_i}|.
+    # Returns sort before invokes at equal positions so an invoke AT t is
+    # not counted (the stab requires invoke_i strictly < t).
+    events = sorted(
+        [(int(t), 1, +1) for t in invoke_idx] +
+        [(int(t), 0, -1) for t in return_idx[finite]])
+    best, open_ops = 1, 0
+    for _t, kind, delta in events:
+        if kind == 0:  # sample before closing the op at its return point
+            best = max(best, open_ops)
+        open_ops += delta
+    return min(best, n)
+
+
+def _hash_keys(length, seed=0x9E3779B9):
+    """Two vectors of random odd uint32 multipliers (multiply-shift
+    universal hashing over config words)."""
+    rng = np.random.RandomState(seed & 0x7FFFFFFF)
+    k = rng.randint(0, 2**31, size=(2, length)).astype(np.uint32)
+    return (k[0] * 2 + 1), (k[1] * 2 + 1)
+
+
+def _mix32(h):
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x846CA68B)
+    h = h ^ (h >> 16)
+    return h
+
+
+# status codes
+RUNNING, VALID = np.int32(0), np.int32(1)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_search(step_fn, n, B, S, C, A, W, O, T):
+    """Compile the search for one shape bundle. Returns a jitted function
+
+        search(invoke, ret, f, args, rets, ok_words, init_state, max_iters)
+          -> dict of final carry scalars + witness arrays
+
+    All array args are device int32/uint32 with the shapes documented in the
+    module docstring; the function is pure so it can be vmapped over a
+    leading key axis.
+    """
+    word_idx = np.arange(n, dtype=np.int32) // 32          # (n,)
+    bit_idx = (np.arange(n, dtype=np.int32) % 32).astype(np.uint32)
+    k1, k2 = _hash_keys(B + S)
+    arange_n = np.arange(n, dtype=np.int32)
+    arange_W = np.arange(W, dtype=np.int32)
+    arange_B = np.arange(B, dtype=np.uint32)
+    M = W * C
+
+    step_one = lambda st, f, a, r: step_fn(st, f, a, r, jnp)  # noqa: E731
+    # vmap over candidates (state shared), then over frontier rows
+    step_vv = jax.vmap(jax.vmap(step_one, in_axes=(None, 0, 0, 0)),
+                       in_axes=(0, 0, 0, 0))
+
+    def fingerprint(words):
+        """words: (M, B+S) uint32 -> two (M,) uint32 hashes."""
+        h1 = _mix32(jnp.sum(words * k1[None, :], axis=1, dtype=jnp.uint32))
+        h2 = _mix32(jnp.sum(words * k2[None, :], axis=1, dtype=jnp.uint32))
+        # reserve (0,0) (empty table slot) and h1=0xFFFFFFFF (invalid-lane
+        # sentinel in the in-batch dedup) so real fingerprints never alias
+        # either
+        h2 = jnp.where((h1 == 0) & (h2 == 0), jnp.uint32(1), h2)
+        h1 = jnp.where(h1 == jnp.uint32(0xFFFFFFFF), jnp.uint32(0xFFFFFFFE),
+                       h1)
+        return h1, h2
+
+    def body(carry, consts):
+        (buf_lin, buf_state, top, tab1, tab2, dropped, status, explored,
+         best_depth, best_lin, best_state, it) = carry
+        invoke, ret, fop, args, rets, ok_words, max_iters = consts
+
+        # -- pop frontier ---------------------------------------------------
+        start = jnp.maximum(top - W, 0)
+        lin = lax.dynamic_slice_in_dim(buf_lin, start, W, axis=0)
+        state = lax.dynamic_slice_in_dim(buf_state, start, W, axis=0)
+        fvalid = (start + arange_W) < top
+        top = start
+
+        # -- candidate selection (the WGL rule) -----------------------------
+        wbits = jnp.take(lin, word_idx, axis=1)               # (W,n)
+        unlin = ((wbits >> bit_idx[None, :]) & jnp.uint32(1)) == 0
+        rmin = jnp.min(jnp.where(unlin, ret[None, :], INF32), axis=1)
+        cand = unlin & (invoke[None, :] < rmin[:, None]) & fvalid[:, None]
+        score = jnp.where(cand, n - arange_n[None, :], 0)
+        vals, ci = lax.top_k(score, C)                        # (W,C)
+        cvalid = vals > 0
+
+        # -- model step over (frontier, candidate) --------------------------
+        fc = jnp.take(fop, ci)                                # (W,C)
+        ac = jnp.take(args, ci, axis=0)                       # (W,C,A)
+        rc = jnp.take(rets, ci, axis=0)
+        st2, okf = step_vv(state, fc, ac, rc)                 # (W,C,S),(W,C)
+        st2 = st2.astype(jnp.int32)
+
+        addmask = jnp.where(
+            arange_B[None, None, :] == jnp.take(word_idx, ci)[..., None]
+            .astype(jnp.uint32),
+            jnp.uint32(1) << jnp.take(bit_idx, ci)[..., None],
+            jnp.uint32(0))                                    # (W,C,B)
+        lin2 = lin[:, None, :] | addmask
+
+        child_valid = cvalid & okf & fvalid[:, None]
+        done = jnp.all((lin2 & ok_words[None, None, :]) == ok_words[None,
+                       None, :], axis=-1)
+        status = jnp.where(jnp.any(child_valid & done), VALID, status)
+
+        # -- witness tracking ----------------------------------------------
+        depth = lax.population_count(lin2 & ok_words[None, None, :]) \
+            .sum(axis=-1).astype(jnp.int32)
+        depth = jnp.where(child_valid, depth, -1).reshape(M)
+        bi = jnp.argmax(depth)
+        better = depth[bi] > best_depth
+        best_depth = jnp.where(better, depth[bi], best_depth)
+        best_lin = jnp.where(better, lin2.reshape(M, B)[bi], best_lin)
+        best_state = jnp.where(better, st2.reshape(M, S)[bi], best_state)
+
+        # -- dedup: fingerprints, in-batch, then table ----------------------
+        lin2f = lin2.reshape(M, B)
+        st2f = st2.reshape(M, S)
+        words = jnp.concatenate([lin2f, st2f.astype(jnp.uint32)], axis=1)
+        h1, h2 = fingerprint(words)
+        cv = child_valid.reshape(M)
+        # Invalid lanes still compute (garbage) configs; give them unique
+        # sentinel fingerprints so they can never alias a real child in the
+        # in-batch dedup sort below.
+        lane = jnp.arange(M, dtype=jnp.uint32)
+        h1 = jnp.where(cv, h1, jnp.uint32(0xFFFFFFFF))
+        h2 = jnp.where(cv, h2, lane)
+
+        sh1, sh2, sidx = lax.sort(
+            (h1, h2, jnp.arange(M, dtype=jnp.int32)), num_keys=2)
+        dup_sorted = jnp.concatenate(
+            [jnp.zeros(1, bool),
+             (sh1[1:] == sh1[:-1]) & (sh2[1:] == sh2[:-1])])
+        dup = jnp.zeros(M, bool).at[sidx].set(dup_sorted)
+
+        slot0 = (h1 & jnp.uint32(T - 1)).astype(jnp.int32)
+        seen = jnp.zeros(M, bool)
+        placed = ~cv | dup        # only first-occurrence valid keys insert
+        for j in range(PROBES):
+            slot = (slot0 + j) & (T - 1)
+            cur1 = tab1[slot]
+            cur2 = tab2[slot]
+            empty = (cur1 == 0) & (cur2 == 0)
+            seen = seen | ((cur1 == h1) & (cur2 == h2) & cv)
+            want = cv & ~placed & ~seen & empty
+            wslot = jnp.where(want, slot, T)
+            tab1 = tab1.at[wslot].set(h1, mode="drop")
+            tab2 = tab2.at[wslot].set(h2, mode="drop")
+            landed = want & (tab1[slot] == h1) & (tab2[slot] == h2)
+            placed = placed | landed
+
+        # -- push fresh configs ---------------------------------------------
+        fresh = cv & ~seen & ~dup
+        offs = jnp.cumsum(fresh.astype(jnp.int32)) - 1
+        cnt = offs[M - 1] + 1
+        pos = jnp.where(fresh, top + offs, O)
+        dropped = dropped | (top + cnt > O)
+        buf_lin = buf_lin.at[pos].set(lin2f, mode="drop")
+        buf_state = buf_state.at[pos].set(st2f, mode="drop")
+        top = jnp.minimum(top + cnt, O)
+
+        explored = explored + fvalid.sum(dtype=jnp.int32)
+        it = it + 1
+        return (buf_lin, buf_state, top, tab1, tab2, dropped, status,
+                explored, best_depth, best_lin, best_state, it)
+
+    def init_carry(init_state):
+        buf_lin = jnp.zeros((O, B), jnp.uint32)
+        buf_state = jnp.zeros((O, S), jnp.int32) \
+            .at[0].set(init_state)
+        return (buf_lin, buf_state, jnp.int32(1),
+                jnp.zeros(T, jnp.uint32), jnp.zeros(T, jnp.uint32),
+                jnp.zeros((), bool), RUNNING, jnp.int32(0),
+                jnp.int32(-1), jnp.zeros(B, jnp.uint32),
+                jnp.zeros(S, jnp.int32), jnp.int32(0))
+
+    def run_chunk(carry, invoke, ret, fop, args, rets, ok_words, bound):
+        """Advance the search until success/exhaustion or iteration
+        ``bound``. Bounded dispatches keep individual device kernels short
+        (long single while_loops can trip runtime watchdogs) and let the
+        host enforce wall-clock budgets between chunks."""
+        consts = (invoke, ret, fop, args, rets, ok_words, bound)
+
+        def cond(c):
+            return (c[6] == RUNNING) & (c[2] > 0) & (c[11] < bound)
+
+        return lax.while_loop(cond, lambda c: body(c, consts), carry)
+
+    return jax.jit(init_carry), jax.jit(run_chunk, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+
+def _bucket(x, lo):
+    """Round up to a power of two (>= lo) so compiled searches are reused
+    across histories of similar size."""
+    return max(lo, 1 << (int(x) - 1).bit_length())
+
+
+def _plan_sizes(n, S, C, frontier_width=None, stack_size=None,
+                table_size=None):
+    B = max(1, (n + 31) // 32)
+    if frontier_width is None:
+        # aim for ~32k candidate expansions per iteration
+        frontier_width = max(32, min(4096, 32768 // max(1, C)))
+    if stack_size is None:
+        # ~128 MB of stack at most
+        per = (B + S) * 4
+        stack_size = max(4096, min(1 << 18, (128 << 20) // per))
+    if table_size is None:
+        table_size = 1 << 20
+    # slot indexing uses h & (T-1): every size must be a power of two
+    return (B, _bucket(frontier_width, 32), _bucket(stack_size, 1024),
+            _bucket(table_size, 1024))
+
+
+def _encode_arrays(e):
+    """Dense int32 arrays for the device search. Invoke/return indices are
+    re-ranked to small ints; INF_TIME becomes INF32."""
+    n = len(e)
+    invoke = e.invoke_idx.astype(np.int64)
+    ret = e.return_idx
+    finite = np.concatenate([invoke, ret[ret < INF_TIME]])
+    ranks = {v: i for i, v in enumerate(np.unique(finite))}
+    inv32 = np.array([ranks[v] for v in invoke], np.int32) \
+        if n else np.zeros(0, np.int32)
+    ret32 = np.array([ranks[v] if v < INF_TIME else INF32 for v in ret],
+                     np.int32) if n else np.zeros(0, np.int32)
+    ok_words = np.zeros(max(1, (n + 31) // 32), np.uint32)
+    for i in range(n):
+        if e.is_ok[i]:
+            ok_words[i // 32] |= np.uint32(1) << np.uint32(i % 32)
+    return inv32, ret32, ok_words
+
+
+def check_encoded(spec, e, init_state, max_configs=50_000_000,
+                  frontier_width=None, stack_size=None, table_size=None,
+                  confirm=False, timeout_s=None, chunk_iters=256):
+    """Device WGL search over an EncodedHistory. Result dict mirrors
+    wgl.check_encoded: {"valid": True|False|"unknown", "configs_explored",
+    ...}, plus device budget diagnostics. ``timeout_s`` bounds wall clock
+    (checked between device chunks of ``chunk_iters`` iterations);
+    exceeding it yields {"valid": "unknown", "error": "timeout"}."""
+    n = len(e)
+    if n == 0 or e.n_ok == 0:
+        return {"valid": True, "configs_explored": 0}
+
+    inv32, ret32, ok_words = _encode_arrays(e)
+    C = max_point_concurrency(inv32, np.where(ret32 == INF32,
+                                              INF_TIME, ret32.astype(np.int64)))
+    A = int(e.args.shape[1]) if e.args.ndim == 2 else 1
+
+    # Pad shapes to power-of-two buckets so the compiled search is reused.
+    # Padding rows are never candidates: they "invoke" after every finite
+    # return (invoke INF32-1 >= any reachable r_min) and are not ok ops.
+    n_pad = _bucket(n, 64)
+    C = min(_bucket(C, 4), n_pad)
+    fop, args, rets = (np.asarray(e.f, np.int32), np.asarray(e.args, np.int32),
+                       np.asarray(e.ret, np.int32))
+    if n_pad > n:
+        pn = n_pad - n
+        inv32 = np.concatenate([inv32, np.full(pn, INF32 - 1, np.int32)])
+        ret32 = np.concatenate([ret32, np.full(pn, INF32, np.int32)])
+        fop = np.concatenate([fop, np.zeros(pn, np.int32)])
+        args = np.concatenate([args, np.zeros((pn, A), np.int32)])
+        rets = np.concatenate([rets, np.zeros((pn, A), np.int32)])
+        # padding rows are never ok ops: just zero-extend the packed bits
+        extra = (n_pad + 31) // 32 - len(ok_words)
+        ok_words = np.concatenate([ok_words, np.zeros(extra, np.uint32)])
+
+    init_state = np.asarray(init_state, np.int32)
+    if spec.pad_state is not None:
+        S_pad = _bucket(init_state.shape[0], 2)
+        init_state = np.asarray(spec.pad_state(init_state, S_pad), np.int32)
+    S = int(init_state.shape[0])
+
+    B, W, O, T = _plan_sizes(n_pad, S, C, frontier_width, stack_size,
+                             table_size)
+    max_iters = max(64, max_configs // W)
+
+    init_carry, run_chunk = _build_search(spec.step, n_pad, B, S, C, A, W,
+                                          O, T)
+    consts = (jnp.asarray(inv32), jnp.asarray(ret32), jnp.asarray(fop),
+              jnp.asarray(args), jnp.asarray(rets), jnp.asarray(ok_words))
+    carry = init_carry(jnp.asarray(init_state))
+    import time as _time
+    t0 = _time.monotonic()
+    timed_out = False
+    it = 0
+    while True:
+        bound = min(it + chunk_iters, max_iters)
+        carry = run_chunk(carry, *consts, jnp.int32(bound))
+        status, top, it = (int(carry[6]), int(carry[2]), int(carry[11]))
+        if status != RUNNING or top == 0 or it >= max_iters:
+            break
+        if timeout_s is not None and _time.monotonic() - t0 > timeout_s:
+            timed_out = True
+            break
+
+    out = {"status": carry[6], "top": carry[2], "dropped": carry[5],
+           "explored": carry[7], "iterations": carry[11],
+           "best_depth": carry[8], "best_lin": carry[9],
+           "best_state": carry[10]}
+    out = jax.device_get(out)
+    if timed_out and int(out["status"]) == RUNNING and int(out["top"]) > 0:
+        return {"valid": "unknown", "error": "timeout",
+                "configs_explored": int(out["explored"]),
+                "iterations": int(out["iterations"]), "engine": "jax-wgl"}
+    return _interpret(spec, e, out, max_iters, confirm, init_state)
+
+
+def _interpret(spec, e, out, max_iters, confirm, init_state):
+    status = int(out["status"])
+    explored = int(out["explored"])
+    result = {"configs_explored": explored,
+              "iterations": int(out["iterations"]),
+              "engine": "jax-wgl"}
+    if status == VALID:
+        result["valid"] = True
+        return result
+    exhausted = int(out["top"]) == 0
+    dropped = bool(out["dropped"])
+    if exhausted and not dropped:
+        result["valid"] = False
+        _attach_witness(result, e, out)
+        if confirm:
+            from . import wgl
+            oracle = wgl.check_encoded(spec, e, init_state)
+            result["confirmed"] = oracle["valid"] is False
+            result["valid"] = oracle["valid"]
+        return result
+    result["valid"] = "unknown"
+    result["error"] = ("stack-overflow" if dropped
+                       else "max-configs-exceeded")
+    return result
+
+
+def _attach_witness(result, e, out):
+    """Decode the deepest stuck configuration into reference-style
+    :op / :final-paths info."""
+    lin = np.asarray(out["best_lin"], np.uint32)
+    n = len(e)
+    linearized = np.zeros(n, bool)
+    for i in range(n):
+        linearized[i] = bool((lin[i // 32] >> np.uint32(i % 32)) & 1)
+    stuck = [i for i in range(n) if e.is_ok[i] and not linearized[i]]
+    if stuck:
+        i = stuck[0]
+        if e.ops is not None:
+            inv, comp = e.ops[i]
+            result["op"] = dict(comp if comp is not None else inv)
+        result["final_state"] = np.asarray(out["best_state"]).tolist()
+        result["linearized_ok_ops"] = int(out["best_depth"])
+
+
+def check_history(spec, history, **kw):
+    """Encode an event history for ``spec`` and run the device search."""
+    e, init_state = spec.encode(history)
+    return check_encoded(spec, e, init_state, **kw)

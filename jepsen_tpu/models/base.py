@@ -110,6 +110,11 @@ class ModelSpec:
     # encode one op: (f, invoke_value, completion_value|None)
     #   -> (fcode, args_list, ret_list)
     encode_op: Callable = None
+    # optional fn(init_state, S_pad) -> padded init state, for models whose
+    # state size is history-dependent (queues). Padding must preserve state
+    # canonicalization so the checker's dedup still sees equal states as
+    # byte-equal. None = state size is fixed, never padded.
+    pad_state: Callable = None
 
     def encode(self, hist):
         """Encode an event history for this model. Returns (EncodedHistory,

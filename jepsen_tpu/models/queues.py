@@ -90,6 +90,14 @@ def _queue_capacity(e):
     return max(1, int((e.f == F_ENQUEUE).sum()))
 
 
+def _pad_nil(state, s_pad):
+    """Grow a queue state by appending empty (NIL) slots: for the left-
+    aligned FIFO this is extra tail capacity; for the all-NIL initial
+    unordered multiset it stays canonical (sorted)."""
+    return np.concatenate(
+        [state, np.full(s_pad - len(state), NIL, np.int32)])
+
+
 def _fifo_step(state, f, args, ret, xp):
     # state = [count, buf[0..C-1]]; front at buf[0]
     C = state.shape[0] - 1
@@ -130,6 +138,7 @@ fifo_queue_spec = register_model(ModelSpec(
     step=_fifo_step,
     make_oracle=FIFOQueue,
     encode_op=_queue_encode,
+    pad_state=_pad_nil,
 ))
 
 
@@ -164,4 +173,5 @@ unordered_queue_spec = register_model(ModelSpec(
     step=_unordered_step,
     make_oracle=UnorderedQueue,
     encode_op=_queue_encode,
+    pad_state=_pad_nil,
 ))

@@ -12,3 +12,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Persistent XLA compilation cache: the WGL search kernels are large; reuse
+# them across pytest runs. Configured via env (picked up when jax is first
+# imported by a test) so jax-free test files don't pay the import.
+import tempfile  # noqa: E402
+
+_cache = os.path.join(tempfile.gettempdir(), f"jax_cache_{os.getuid()}")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")

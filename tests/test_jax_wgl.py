@@ -1,0 +1,248 @@
+"""Differential tests: batched device WGL vs the sequential CPU oracle.
+
+Mirrors the reference's test strategy (SURVEY.md section 4): the TPU engine
+gets an extra cross-validation level the reference outsources to knossos's
+own repo -- randomized small histories checked by both engines must agree.
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu import history as h
+from jepsen_tpu import models
+from jepsen_tpu.checker import jax_wgl, wgl
+
+H = h.parse_history_edn_like
+
+
+# -- canned histories --------------------------------------------------------
+
+def test_trivial_valid():
+    hist = H([("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+              ("invoke", 0, "read", None), ("ok", 0, "read", 1)])
+    r = jax_wgl.check_history(models.register_spec, hist)
+    assert r["valid"] is True
+
+
+def test_trivial_invalid():
+    hist = H([("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+              ("invoke", 0, "read", None), ("ok", 0, "read", 2)])
+    r = jax_wgl.check_history(models.register_spec, hist)
+    assert r["valid"] is False
+    assert r.get("op", {}).get("f") == "read"
+
+
+def test_concurrent_reorder_valid():
+    # write 1 and write 2 concurrent; read sees 1 then another read sees 1:
+    # linearizable by ordering w2 < w1.
+    hist = H([
+        ("invoke", 0, "write", 1),
+        ("invoke", 1, "write", 2),
+        ("ok", 0, "write", 1),
+        ("ok", 1, "write", 2),
+        ("invoke", 2, "read", None), ("ok", 2, "read", 1),
+        ("invoke", 2, "read", None), ("ok", 2, "read", 1),
+    ])
+    assert jax_wgl.check_history(models.register_spec, hist)["valid"] is True
+
+
+def test_realtime_order_enforced():
+    # w1 completes before w2 begins; read of 1 after w2 ok is invalid.
+    hist = H([
+        ("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+        ("invoke", 0, "write", 2), ("ok", 0, "write", 2),
+        ("invoke", 1, "read", None), ("ok", 1, "read", 1),
+    ])
+    assert jax_wgl.check_history(models.register_spec, hist)["valid"] is False
+
+
+def test_info_op_may_happen():
+    # crashed write may or may not have taken effect: read may see it.
+    hist = H([
+        ("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+        ("invoke", 1, "write", 2), ("info", 1, "write", 2),
+        ("invoke", 2, "read", None), ("ok", 2, "read", 2),
+    ])
+    assert jax_wgl.check_history(models.register_spec, hist)["valid"] is True
+
+
+def test_info_op_may_not_happen():
+    hist = H([
+        ("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+        ("invoke", 1, "write", 2), ("info", 1, "write", 2),
+        ("invoke", 2, "read", None), ("ok", 2, "read", 1),
+    ])
+    assert jax_wgl.check_history(models.register_spec, hist)["valid"] is True
+
+
+def test_cas_history():
+    hist = H([
+        ("invoke", 0, "write", 0), ("ok", 0, "write", 0),
+        ("invoke", 1, "cas", (0, 1)), ("ok", 1, "cas", (0, 1)),
+        ("invoke", 2, "cas", (1, 2)), ("ok", 2, "cas", (1, 2)),
+        ("invoke", 0, "read", None), ("ok", 0, "read", 2),
+    ])
+    assert jax_wgl.check_history(models.cas_register_spec, hist)["valid"] \
+        is True
+
+
+def test_mutex_invalid_double_acquire():
+    hist = H([
+        ("invoke", 0, "acquire", None), ("ok", 0, "acquire", None),
+        ("invoke", 1, "acquire", None), ("ok", 1, "acquire", None),
+    ])
+    assert jax_wgl.check_history(models.mutex_spec, hist)["valid"] is False
+
+
+def test_fifo_queue_valid():
+    hist = H([
+        ("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+        ("invoke", 0, "enqueue", 2), ("ok", 0, "enqueue", 2),
+        ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 1),
+        ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 2),
+    ])
+    assert jax_wgl.check_history(models.fifo_queue_spec, hist)["valid"] is True
+
+
+def test_fifo_queue_invalid_order():
+    hist = H([
+        ("invoke", 0, "enqueue", 1), ("ok", 0, "enqueue", 1),
+        ("invoke", 0, "enqueue", 2), ("ok", 0, "enqueue", 2),
+        ("invoke", 1, "dequeue", None), ("ok", 1, "dequeue", 2),
+    ])
+    assert jax_wgl.check_history(models.fifo_queue_spec, hist)["valid"] \
+        is False
+
+
+# -- randomized differential tests ------------------------------------------
+
+def _random_history(rng, spec_name, n_procs, n_ops, crash_p=0.1):
+    """Simulate a concurrent run against a real sequential object, with
+    occasional lost (info) completions -- yields histories that are mostly
+    linearizable but sometimes corrupted below."""
+    hist = []
+    if spec_name in ("register", "cas-register"):
+        state = {"v": None}
+
+        def gen_invoke(p):
+            f = rng.choice(["read", "write", "cas"]
+                           if spec_name == "cas-register"
+                           else ["read", "write"])
+            if f == "read":
+                return h.invoke_op(p, "read", None)
+            if f == "write":
+                return h.invoke_op(p, "write", rng.randrange(4))
+            return h.invoke_op(p, "cas", (rng.randrange(4), rng.randrange(4)))
+
+        def apply(inv):
+            f, v = inv["f"], inv["value"]
+            if f == "read":
+                return True, state["v"]
+            if f == "write":
+                state["v"] = v
+                return True, v
+            old, new = v
+            if state["v"] == old:
+                state["v"] = new
+                return True, v
+            return False, v
+    elif spec_name == "mutex":
+        state = {"locked": False}
+
+        def gen_invoke(p):
+            return h.invoke_op(p, rng.choice(["acquire", "release"]), None)
+
+        def apply(inv):
+            if inv["f"] == "acquire":
+                if state["locked"]:
+                    return False, None
+                state["locked"] = True
+                return True, None
+            if not state["locked"]:
+                return False, None
+            state["locked"] = False
+            return True, None
+    else:  # fifo-queue
+        state = {"q": [], "next": 0}
+
+        def gen_invoke(p):
+            if rng.random() < 0.5:
+                state["next"] += 1
+                return h.invoke_op(p, "enqueue", state["next"])
+            return h.invoke_op(p, "dequeue", None)
+
+        def apply(inv):
+            if inv["f"] == "enqueue":
+                state["q"].append(inv["value"])
+                return True, inv["value"]
+            if state["q"]:
+                return True, state["q"].pop(0)
+            return False, None
+
+    outstanding = {}
+    ops_done = 0
+    while ops_done < n_ops or outstanding:
+        free = [p for p in range(n_procs) if p not in outstanding]
+        if free and ops_done < n_ops and (not outstanding or rng.random() < .6):
+            p = rng.choice(free)
+            inv = gen_invoke(p)
+            outstanding[p] = inv
+            hist.append(inv)
+            ops_done += 1
+        else:
+            p = rng.choice(list(outstanding))
+            inv = outstanding.pop(p)
+            took_effect, res = apply(inv)
+            if rng.random() < crash_p:
+                hist.append(h.info_op(p, inv["f"], inv["value"]))
+            elif took_effect:
+                v = res if inv["f"] in ("read", "dequeue") else inv["value"]
+                hist.append(h.ok_op(p, inv["f"], v))
+            else:
+                hist.append(h.fail_op(p, inv["f"], inv["value"]))
+    return h.index(hist)
+
+
+def _corrupt(rng, hist):
+    """Flip a completion value to (probably) break linearizability."""
+    hist = [h.Op(o) for o in hist]
+    cands = [i for i, o in enumerate(hist)
+             if o["type"] == "ok" and o["f"] in ("read", "dequeue")
+             and o.get("value") is not None]
+    if not cands:
+        return hist
+    i = rng.choice(cands)
+    hist[i]["value"] = (hist[i]["value"] or 0) + rng.randrange(1, 5)
+    return hist
+
+
+SPECS = {"register": "register_spec", "cas-register": "cas_register_spec",
+         "mutex": "mutex_spec", "fifo-queue": "fifo_queue_spec"}
+
+
+@pytest.mark.parametrize("spec_name", list(SPECS))
+def test_differential_random(spec_name):
+    spec = getattr(models, SPECS[spec_name])
+    rng = random.Random(45100)  # reference's fixed seed (generator/test.clj)
+    for trial in range(12):
+        hist = _random_history(rng, spec_name, n_procs=4, n_ops=14)
+        if trial % 2:
+            hist = _corrupt(rng, hist)
+        expect = wgl.check_history(spec, hist)
+        got = jax_wgl.check_history(spec, hist)
+        assert got["valid"] == expect["valid"], (
+            f"{spec_name} trial {trial}: oracle={expect['valid']} "
+            f"device={got['valid']}\nhistory:\n" +
+            "\n".join(str(o) for o in hist))
+
+
+def test_differential_larger_register():
+    rng = random.Random(7)
+    spec = models.cas_register_spec
+    for trial in range(4):
+        hist = _random_history(rng, "cas-register", n_procs=6, n_ops=60,
+                               crash_p=0.05)
+        expect = wgl.check_history(spec, hist)
+        got = jax_wgl.check_history(spec, hist)
+        assert got["valid"] == expect["valid"]

@@ -1,0 +1,76 @@
+"""Benchmark: ops verified/sec on CAS-register histories (BASELINE.json).
+
+Measures the device WGL engine on the BASELINE config ladder's first two
+rungs: (1) single ~200-op cas-register histories, (2) a multi-key batch
+(jepsen.independent-style) checked in one vmapped program. The baseline is
+the sequential CPU oracle (our knossos stand-in, checker/wgl.py) on the
+same histories.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import random
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+
+def main():
+    from jepsen_tpu.checker import wgl
+    from jepsen_tpu.models import cas_register_spec
+    from jepsen_tpu.parallel import check_batch_encoded
+    from jepsen_tpu.simulate import corrupt, random_history
+
+    spec = cas_register_spec
+    rng = random.Random(45100)
+    n_keys, ops_per_key = 32, 200
+    hists = []
+    for k in range(n_keys):
+        hist = random_history(rng, "cas-register", n_procs=8,
+                              n_ops=ops_per_key, crash_p=0.02)
+        if k % 8 == 7:
+            hist = corrupt(rng, hist)
+        hists.append(hist)
+    pairs = [spec.encode(hist) for hist in hists]
+    total_ops = sum(len(e) for e, _ in pairs)
+
+    # CPU baseline: sequential WGL oracle over all keys
+    t0 = time.monotonic()
+    base_results = [wgl.check_encoded(spec, e, st) for e, st in pairs]
+    cpu_s = time.monotonic() - t0
+    cpu_rate = total_ops / cpu_s
+
+    # Device: warm up with the identical shape bundle (compile), then measure
+    check_batch_encoded(spec, pairs)
+    t0 = time.monotonic()
+    dev_results = check_batch_encoded(spec, pairs)
+    dev_s = time.monotonic() - t0
+    dev_rate = total_ops / dev_s
+
+    agree = sum(1 for a, b in zip(base_results, dev_results)
+                if a["valid"] == b["valid"])
+    if agree != n_keys:
+        print(json.dumps({"metric": "ops verified/sec (cas-register)",
+                          "value": 0.0, "unit": "ops/s",
+                          "vs_baseline": 0.0,
+                          "error": f"verdict mismatch: {agree}/{n_keys}"}))
+        return
+
+    print(json.dumps({
+        "metric": "ops verified/sec (cas-register)",
+        "value": round(dev_rate, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(dev_rate / cpu_rate, 3),
+        "detail": {
+            "keys": n_keys, "ops_per_key": ops_per_key,
+            "total_ops": total_ops,
+            "device_s": round(dev_s, 3), "cpu_oracle_s": round(cpu_s, 3),
+            "cpu_oracle_rate": round(cpu_rate, 1),
+            "verdicts_agree": agree,
+        }}))
+
+
+if __name__ == "__main__":
+    main()

@@ -114,162 +114,240 @@ def _mix32(h):
 RUNNING, VALID = np.int32(0), np.int32(1)
 
 
+#: carry tuple element indices with a per-key leading axis (the rest are
+#: shared per table-group); the batch checker's compaction gathers these
+KEYED = (0, 1, 2, 5, 6, 7, 8, 9, 10, 11)
+
+
 @functools.lru_cache(maxsize=64)
-def _build_search(step_fn, n, B, S, C, A, W, O, T):
-    """Compile the search for one shape bundle. Returns a jitted function
+def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1):
+    """Compile the search for one shape bundle with an explicit key-batch
+    axis K (jepsen.independent keys, BASELINE config 2). Returns jitted
 
-        search(invoke, ret, f, args, rets, ok_words, init_state, max_iters)
-          -> dict of final carry scalars + witness arrays
+        init_carry(init_states (K,S)) -> carry
+        run_chunk(carry, invoke, ret, f, args, rets, ok_words, salt, bound)
+          -> carry
 
-    All array args are device int32/uint32 with the shapes documented in the
-    module docstring; the function is pure so it can be vmapped over a
-    leading key axis.
+    The K axis is batched *manually* (not vmap): all keys share one dedup
+    table (fingerprints salted by key id) and one flat scatter per
+    structure per iteration -- vmapping the table ops made XLA:TPU
+    serialize the scatters per key and copy the (K,T) tables every
+    iteration, which dominated runtime.
+
+    Carry layout (see KEYED): buf_lin (K,O,B) u32, buf_state (K,O,S) i32,
+    top (K,) i32, tab1/tab2 (G,T) u32 shared, dropped (K,) bool, status (K,)
+    i32, explored (K,) i32, best_depth (K,) i32, best_lin (K,B) u32,
+    best_state (K,S) i32, its (K,) i32, it (G,) i32, claim (G,Tc) i32
+    shared. G is the table-group count: 1 locally; under shard_map over a
+    mesh, G = mesh size so each device shard sees exactly one group (the
+    body always indexes group 0 of its local view). Buffers depend on O/B/S/T but NOT on W, so kernel variants with
+    different frontier widths are interchangeable mid-search (the batch
+    checker widens W once stragglers remain).
     """
     word_idx = np.arange(n, dtype=np.int32) // 32          # (n,)
     bit_idx = (np.arange(n, dtype=np.int32) % 32).astype(np.uint32)
-    k1, k2 = _hash_keys(B + S)
+    k1, k2 = _hash_keys(B + S + 1)                         # +1: key salt
     arange_n = np.arange(n, dtype=np.int32)
     arange_W = np.arange(W, dtype=np.int32)
     arange_B = np.arange(B, dtype=np.uint32)
+    arange_C = np.arange(C, dtype=np.int32)
+    arange_K = np.arange(K, dtype=np.int32)
     M = W * C
+    KM = K * M
+    Tc = 1 << 16   # twin-claim scratch; fixed so carries are W-independent
 
     step_one = lambda st, f, a, r: step_fn(st, f, a, r, jnp)  # noqa: E731
-    # vmap over candidates (state shared), then over frontier rows
-    step_vv = jax.vmap(jax.vmap(step_one, in_axes=(None, 0, 0, 0)),
-                       in_axes=(0, 0, 0, 0))
+    # vmap over candidates (state shared), frontier rows, then keys
+    step_vvv = jax.vmap(jax.vmap(jax.vmap(
+        step_one, in_axes=(None, 0, 0, 0)), in_axes=(0, 0, 0, 0)),
+        in_axes=(0, 0, 0, 0))
 
     def fingerprint(words):
-        """words: (M, B+S) uint32 -> two (M,) uint32 hashes."""
-        h1 = _mix32(jnp.sum(words * k1[None, :], axis=1, dtype=jnp.uint32))
-        h2 = _mix32(jnp.sum(words * k2[None, :], axis=1, dtype=jnp.uint32))
-        # reserve (0,0) (empty table slot) and h1=0xFFFFFFFF (invalid-lane
-        # sentinel in the in-batch dedup) so real fingerprints never alias
-        # either
+        """words: (KM, B+S+1) uint32 -> two (KM,) uint32 hashes.
+
+        Each word is xored with a per-position random key and passed through
+        the bijective finalizer _before_ summing. A plain keyed linear sum
+        (sum of w*k mod 2^32) is catastrophically weak in the high bits --
+        configs differing only in bit 31 of two different words always
+        collide, since 2^31*(k_i - k_j) = 0 mod 2^32 for odd keys -- and
+        such sibling configs are extremely common in this search."""
+        h1 = _mix32(jnp.sum(_mix32(words ^ k1[None, :]), axis=1,
+                            dtype=jnp.uint32))
+        h2 = _mix32(jnp.sum(_mix32(words ^ k2[None, :]), axis=1,
+                            dtype=jnp.uint32))
+        # reserve (0,0): the empty table slot
         h2 = jnp.where((h1 == 0) & (h2 == 0), jnp.uint32(1), h2)
-        h1 = jnp.where(h1 == jnp.uint32(0xFFFFFFFF), jnp.uint32(0xFFFFFFFE),
-                       h1)
         return h1, h2
 
     def body(carry, consts):
-        (buf_lin, buf_state, top, tab1, tab2, dropped, status, explored,
-         best_depth, best_lin, best_state, it) = carry
-        invoke, ret, fop, args, rets, ok_words, max_iters = consts
+        (buf_lin, buf_state, top, tab1g, tab2g, dropped, status, explored,
+         best_depth, best_lin, best_state, its, it, claimg) = carry
+        tab1, tab2, claim = tab1g[0], tab2g[0], claimg[0]
+        invoke, ret, fop, args, rets, ok_words, salt, bound = consts
+        running = (status == RUNNING) & (top > 0)             # (K,)
 
-        # -- pop frontier ---------------------------------------------------
-        start = jnp.maximum(top - W, 0)
-        lin = lax.dynamic_slice_in_dim(buf_lin, start, W, axis=0)
-        state = lax.dynamic_slice_in_dim(buf_state, start, W, axis=0)
-        fvalid = (start + arange_W) < top
+        # -- pop per-key frontiers ------------------------------------------
+        start = jnp.where(running, jnp.maximum(top - W, 0), top)
+        idx = start[:, None] + arange_W[None, :]              # (K,W)
+        fvalid = (idx < top[:, None]) & running[:, None]
+        gidx = (arange_K[:, None] * O + jnp.minimum(idx, O - 1)).reshape(KM
+                 // C)
+        lin = jnp.take(buf_lin.reshape(K * O, B), gidx,
+                       axis=0).reshape(K, W, B)
+        state = jnp.take(buf_state.reshape(K * O, S), gidx,
+                         axis=0).reshape(K, W, S)
         top = start
 
         # -- candidate selection (the WGL rule) -----------------------------
-        wbits = jnp.take(lin, word_idx, axis=1)               # (W,n)
-        unlin = ((wbits >> bit_idx[None, :]) & jnp.uint32(1)) == 0
-        rmin = jnp.min(jnp.where(unlin, ret[None, :], INF32), axis=1)
-        cand = unlin & (invoke[None, :] < rmin[:, None]) & fvalid[:, None]
-        score = jnp.where(cand, n - arange_n[None, :], 0)
-        vals, ci = lax.top_k(score, C)                        # (W,C)
-        cvalid = vals > 0
+        wbits = jnp.take(lin, word_idx, axis=2)               # (K,W,n)
+        unlin = ((wbits >> bit_idx[None, None, :]) & jnp.uint32(1)) == 0
+        rmin = jnp.min(jnp.where(unlin, ret[:, None, :], INF32), axis=2)
+        cand = unlin & (invoke[:, None, :] < rmin[..., None]) \
+            & fvalid[..., None]
+        # First C candidate positions per row without top_k (which lowers
+        # to per-row sorts on TPU): rank by prefix sum, reduce a one-hot.
+        rank = jnp.cumsum(cand.astype(jnp.int32), axis=2)     # (K,W,n)
+        onehot = (rank[..., None] == (arange_C[None, None, None, :] + 1)) \
+            & cand[..., None]                                 # (K,W,n,C)
+        ci = jnp.sum(onehot * arange_n[None, None, :, None], axis=2)
+        cvalid = arange_C[None, None, :] < rank[..., -1:]     # (K,W,C)
 
-        # -- model step over (frontier, candidate) --------------------------
-        fc = jnp.take(fop, ci)                                # (W,C)
-        ac = jnp.take(args, ci, axis=0)                       # (W,C,A)
-        rc = jnp.take(rets, ci, axis=0)
-        st2, okf = step_vv(state, fc, ac, rc)                 # (W,C,S),(W,C)
+        # -- model step over (key, frontier, candidate) ---------------------
+        gci = (arange_K[:, None, None] * n + ci).reshape(KM)
+        fc = jnp.take(fop.reshape(K * n), gci).reshape(K, W, C)
+        ac = jnp.take(args.reshape(K * n, A), gci,
+                      axis=0).reshape(K, W, C, A)
+        rc = jnp.take(rets.reshape(K * n, A), gci,
+                      axis=0).reshape(K, W, C, A)
+        st2, okf = step_vvv(state, fc, ac, rc)            # (K,W,C,S),(K,W,C)
         st2 = st2.astype(jnp.int32)
 
         addmask = jnp.where(
-            arange_B[None, None, :] == jnp.take(word_idx, ci)[..., None]
-            .astype(jnp.uint32),
+            arange_B[None, None, None, :]
+            == jnp.take(word_idx, ci)[..., None].astype(jnp.uint32),
             jnp.uint32(1) << jnp.take(bit_idx, ci)[..., None],
-            jnp.uint32(0))                                    # (W,C,B)
-        lin2 = lin[:, None, :] | addmask
+            jnp.uint32(0))                                    # (K,W,C,B)
+        lin2 = lin[:, :, None, :] | addmask
 
-        child_valid = cvalid & okf & fvalid[:, None]
-        done = jnp.all((lin2 & ok_words[None, None, :]) == ok_words[None,
-                       None, :], axis=-1)
-        status = jnp.where(jnp.any(child_valid & done), VALID, status)
+        child_valid = cvalid & okf & fvalid[..., None]
+        okw = ok_words[:, None, None, :]
+        done = jnp.all((lin2 & okw) == okw, axis=-1)
+        status = jnp.where(
+            running & jnp.any(child_valid & done, axis=(1, 2)),
+            VALID, status)
 
         # -- witness tracking ----------------------------------------------
-        depth = lax.population_count(lin2 & ok_words[None, None, :]) \
-            .sum(axis=-1).astype(jnp.int32)
-        depth = jnp.where(child_valid, depth, -1).reshape(M)
-        bi = jnp.argmax(depth)
-        better = depth[bi] > best_depth
-        best_depth = jnp.where(better, depth[bi], best_depth)
-        best_lin = jnp.where(better, lin2.reshape(M, B)[bi], best_lin)
-        best_state = jnp.where(better, st2.reshape(M, S)[bi], best_state)
+        depth = lax.population_count(lin2 & okw).sum(axis=-1) \
+            .astype(jnp.int32)
+        depth = jnp.where(child_valid, depth, -1).reshape(K, M)
+        bi = jnp.argmax(depth, axis=1)                        # (K,)
+        bd = jnp.take_along_axis(depth, bi[:, None], axis=1)[:, 0]
+        better = bd > best_depth
+        best_depth = jnp.where(better, bd, best_depth)
+        lin2k = lin2.reshape(K, M, B)
+        st2k = st2.reshape(K, M, S)
+        best_lin = jnp.where(
+            better[:, None],
+            jnp.take_along_axis(lin2k, bi[:, None, None], axis=1)[:, 0],
+            best_lin)
+        best_state = jnp.where(
+            better[:, None],
+            jnp.take_along_axis(st2k, bi[:, None, None], axis=1)[:, 0],
+            best_state)
 
-        # -- dedup: fingerprints, in-batch, then table ----------------------
-        lin2f = lin2.reshape(M, B)
-        st2f = st2.reshape(M, S)
-        words = jnp.concatenate([lin2f, st2f.astype(jnp.uint32)], axis=1)
+        # -- fingerprints (key-salted: all keys share the tables) -----------
+        lin2f = lin2.reshape(KM, B)
+        st2f = st2.reshape(KM, S)
+        saltw = jnp.broadcast_to(salt[:, None], (K, M)).reshape(KM)
+        words = jnp.concatenate(
+            [lin2f, st2f.astype(jnp.uint32), saltw[:, None]], axis=1)
         h1, h2 = fingerprint(words)
-        cv = child_valid.reshape(M)
-        # Invalid lanes still compute (garbage) configs; give them unique
-        # sentinel fingerprints so they can never alias a real child in the
-        # in-batch dedup sort below.
-        lane = jnp.arange(M, dtype=jnp.uint32)
-        h1 = jnp.where(cv, h1, jnp.uint32(0xFFFFFFFF))
-        h2 = jnp.where(cv, h2, lane)
+        cv = child_valid.reshape(KM)
 
-        sh1, sh2, sidx = lax.sort(
-            (h1, h2, jnp.arange(M, dtype=jnp.int32)), num_keys=2)
-        dup_sorted = jnp.concatenate(
-            [jnp.zeros(1, bool),
-             (sh1[1:] == sh1[:-1]) & (sh2[1:] == sh2[:-1])])
-        dup = jnp.zeros(M, bool).at[sidx].set(dup_sorted)
+        # In-batch twin dedup: parents in the same frontier often generate
+        # identical children (diamond orders); left unchecked each copy is
+        # pushed and re-expanded (~6x measured blowup on exhaustion
+        # proofs). Every valid lane claims a slot keyed by fingerprint in a
+        # small persistent scratch; of the lanes with equal fingerprints at
+        # a claimed slot, exactly the scatter winner survives. Distinct-
+        # fingerprint collisions just mean both survive (extra work only).
+        # Stale claims are unreadable: a slot is only read by lanes that
+        # wrote it this iteration.
+        lane = jnp.arange(KM, dtype=jnp.int32)
+        cslot = jnp.where(cv, (h1 & jnp.uint32(Tc - 1)).astype(jnp.int32),
+                          Tc)
+        claim = claim.at[cslot].set(lane, mode="drop")
+        winner = claim.at[cslot].get(mode="fill", fill_value=0)
+        dup = cv & (winner != lane) & (jnp.take(h1, winner) == h1) \
+            & (jnp.take(h2, winner) == h2)
 
+        # One vectorized probe round against the shared seen-table: gather
+        # all PROBES slots at once, then a single scatter into the first
+        # empty slot. Scatter-race losers are simply not recorded (their
+        # configs may be re-explored later; extra work, never lost work).
         slot0 = (h1 & jnp.uint32(T - 1)).astype(jnp.int32)
-        seen = jnp.zeros(M, bool)
-        placed = ~cv | dup        # only first-occurrence valid keys insert
-        for j in range(PROBES):
-            slot = (slot0 + j) & (T - 1)
-            cur1 = tab1[slot]
-            cur2 = tab2[slot]
-            empty = (cur1 == 0) & (cur2 == 0)
-            seen = seen | ((cur1 == h1) & (cur2 == h2) & cv)
-            want = cv & ~placed & ~seen & empty
-            wslot = jnp.where(want, slot, T)
-            tab1 = tab1.at[wslot].set(h1, mode="drop")
-            tab2 = tab2.at[wslot].set(h2, mode="drop")
-            landed = want & (tab1[slot] == h1) & (tab2[slot] == h2)
-            placed = placed | landed
+        slots = (slot0[:, None]
+                 + jnp.arange(PROBES, dtype=jnp.int32)[None, :]) & (T - 1)
+        slots = jnp.where((cv & ~dup)[:, None], slots, T)
+        cur1 = tab1.at[slots].get(mode="fill", fill_value=0)   # (KM,P)
+        cur2 = tab2.at[slots].get(mode="fill", fill_value=0)
+        seen = ((cur1 == h1[:, None]) & (cur2 == h2[:, None])).any(axis=1) \
+            & cv & ~dup
+        empty = (cur1 == 0) & (cur2 == 0)
+        first_empty = jnp.argmax(empty, axis=1)
+        islot = jnp.take_along_axis(slots, first_empty[:, None],
+                                    axis=1)[:, 0]
+        want = cv & ~dup & ~seen & empty.any(axis=1)
+        wslot = jnp.where(want, islot, T)
+        tab1 = tab1.at[wslot].set(h1, mode="drop")
+        tab2 = tab2.at[wslot].set(h2, mode="drop")
 
-        # -- push fresh configs ---------------------------------------------
-        fresh = cv & ~seen & ~dup
-        offs = jnp.cumsum(fresh.astype(jnp.int32)) - 1
-        cnt = offs[M - 1] + 1
-        pos = jnp.where(fresh, top + offs, O)
-        dropped = dropped | (top + cnt > O)
-        buf_lin = buf_lin.at[pos].set(lin2f, mode="drop")
-        buf_state = buf_state.at[pos].set(st2f, mode="drop")
+        # -- push fresh configs (per-key positions, one flat scatter) -------
+        fresh = (cv & ~dup & ~seen).reshape(K, M)
+        offs = jnp.cumsum(fresh.astype(jnp.int32), axis=1) - 1
+        cnt = offs[:, -1] + 1                                  # (K,)
+        pos = jnp.where(fresh, top[:, None] + offs, O)
+        dropped = dropped | (running & (top + cnt > O))
+        fpos = jnp.where(pos < O, arange_K[:, None] * O + pos,
+                         K * O).reshape(KM)
+        buf_lin = buf_lin.reshape(K * O, B).at[fpos] \
+            .set(lin2f, mode="drop").reshape(K, O, B)
+        buf_state = buf_state.reshape(K * O, S).at[fpos] \
+            .set(st2f, mode="drop").reshape(K, O, S)
         top = jnp.minimum(top + cnt, O)
 
-        explored = explored + fvalid.sum(dtype=jnp.int32)
+        explored = explored + jnp.where(running,
+                                        fvalid.sum(axis=1,
+                                                   dtype=jnp.int32), 0)
+        its = its + running.astype(jnp.int32)
         it = it + 1
-        return (buf_lin, buf_state, top, tab1, tab2, dropped, status,
-                explored, best_depth, best_lin, best_state, it)
+        return (buf_lin, buf_state, top, tab1[None], tab2[None], dropped,
+                status, explored, best_depth, best_lin, best_state, its,
+                it, claim[None])
 
-    def init_carry(init_state):
-        buf_lin = jnp.zeros((O, B), jnp.uint32)
-        buf_state = jnp.zeros((O, S), jnp.int32) \
-            .at[0].set(init_state)
-        return (buf_lin, buf_state, jnp.int32(1),
-                jnp.zeros(T, jnp.uint32), jnp.zeros(T, jnp.uint32),
-                jnp.zeros((), bool), RUNNING, jnp.int32(0),
-                jnp.int32(-1), jnp.zeros(B, jnp.uint32),
-                jnp.zeros(S, jnp.int32), jnp.int32(0))
+    def init_carry(init_states):
+        buf_lin = jnp.zeros((K, O, B), jnp.uint32)
+        buf_state = jnp.zeros((K, O, S), jnp.int32) \
+            .at[:, 0, :].set(init_states)
+        return (buf_lin, buf_state, jnp.ones(K, jnp.int32),
+                jnp.zeros((G, T), jnp.uint32), jnp.zeros((G, T), jnp.uint32),
+                jnp.zeros(K, bool), jnp.full(K, RUNNING),
+                jnp.zeros(K, jnp.int32),
+                jnp.full(K, -1, jnp.int32), jnp.zeros((K, B), jnp.uint32),
+                jnp.zeros((K, S), jnp.int32), jnp.zeros(K, jnp.int32),
+                jnp.zeros(G, jnp.int32), jnp.zeros((G, Tc), jnp.int32))
 
-    def run_chunk(carry, invoke, ret, fop, args, rets, ok_words, bound):
-        """Advance the search until success/exhaustion or iteration
-        ``bound``. Bounded dispatches keep individual device kernels short
-        (long single while_loops can trip runtime watchdogs) and let the
-        host enforce wall-clock budgets between chunks."""
-        consts = (invoke, ret, fop, args, rets, ok_words, bound)
+    def run_chunk(carry, invoke, ret, fop, args, rets, ok_words, salt,
+                  bound):
+        """Advance the search until every key succeeds/exhausts or the
+        iteration counter reaches ``bound``. Bounded dispatches keep device
+        kernels short (long single while_loops can trip runtime watchdogs)
+        and let the host enforce wall-clock budgets between chunks."""
+        consts = (invoke, ret, fop, args, rets, ok_words, salt, bound)
 
         def cond(c):
-            return (c[6] == RUNNING) & (c[2] > 0) & (c[11] < bound)
+            return jnp.any((c[6] == RUNNING) & (c[2] > 0)) \
+                & (c[12][0] < bound)
 
         return lax.while_loop(cond, lambda c: body(c, consts), carry)
 
@@ -366,11 +444,13 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
                              table_size)
     max_iters = max(64, max_configs // W)
 
-    init_carry, run_chunk = _build_search(spec.step, n_pad, B, S, C, A, W,
-                                          O, T)
-    consts = (jnp.asarray(inv32), jnp.asarray(ret32), jnp.asarray(fop),
-              jnp.asarray(args), jnp.asarray(rets), jnp.asarray(ok_words))
-    carry = init_carry(jnp.asarray(init_state))
+    init_carry, run_chunk = _build_search(spec.step, 1, n_pad, B, S, C, A,
+                                          W, O, T)
+    consts = (jnp.asarray(inv32[None]), jnp.asarray(ret32[None]),
+              jnp.asarray(fop[None]), jnp.asarray(args[None]),
+              jnp.asarray(rets[None]), jnp.asarray(ok_words[None]),
+              jnp.zeros(1, jnp.uint32))
+    carry = init_carry(jnp.asarray(init_state[None]))
     import time as _time
     t0 = _time.monotonic()
     timed_out = False
@@ -378,17 +458,18 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     while True:
         bound = min(it + chunk_iters, max_iters)
         carry = run_chunk(carry, *consts, jnp.int32(bound))
-        status, top, it = (int(carry[6]), int(carry[2]), int(carry[11]))
+        status, top, it = (int(carry[6][0]), int(carry[2][0]),
+                           int(carry[12][0]))
         if status != RUNNING or top == 0 or it >= max_iters:
             break
         if timeout_s is not None and _time.monotonic() - t0 > timeout_s:
             timed_out = True
             break
 
-    out = {"status": carry[6], "top": carry[2], "dropped": carry[5],
-           "explored": carry[7], "iterations": carry[11],
-           "best_depth": carry[8], "best_lin": carry[9],
-           "best_state": carry[10]}
+    out = {"status": carry[6][0], "top": carry[2][0],
+           "dropped": carry[5][0], "explored": carry[7][0],
+           "iterations": carry[11][0], "best_depth": carry[8][0],
+           "best_lin": carry[9][0], "best_state": carry[10][0]}
     out = jax.device_get(out)
     if timed_out and int(out["status"]) == RUNNING and int(out["top"]) > 0:
         return {"valid": "unknown", "error": "timeout",

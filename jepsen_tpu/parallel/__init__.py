@@ -1,0 +1,11 @@
+"""Device-mesh parallelism for the checker.
+
+The reference scales linearizability checking by sharding *keys*
+(jepsen.independent splits one multi-key history into per-key subhistories
+checked via bounded-pmap, independent.clj:285) and by racing search
+strategies (knossos.competition). Here the key axis becomes a vmap batch
+dimension sharded over a ``jax.sharding.Mesh`` (SURVEY.md section 5
+"Distributed communication backend").
+"""
+
+from .keyshard import check_batch_encoded, check_batch_histories  # noqa: F401

@@ -1,0 +1,258 @@
+"""Multi-key batched linearizability checking, sharded over a device mesh.
+
+jepsen.independent lifts a single-key test to many keys and checks per-key
+subhistories in parallel on CPU threads (reference independent.clj:264-315,
+bounded-pmap at :285). The TPU design makes the key axis an explicit batch
+dimension of the WGL search kernel (BASELINE.json config 2): every key's
+branch-and-bound advances in lockstep inside one compiled program, sharing
+one key-salted dedup table and one flat scatter per structure per iteration.
+
+Scale-out: with a 1-D ``Mesh`` the same kernel runs under ``shard_map`` --
+keys shard over the mesh axis, and every carry element (including the dedup
+tables, which carry a leading group axis sized to the mesh) shards with
+them, so each device runs its shard's searches independently over ICI-local
+memory with no collectives in the hot loop (embarrassingly parallel, the
+right layout for this workload; SURVEY.md section 5).
+
+Keys finish at different times; the host polls per-key status between
+bounded chunks, harvests finished keys, and *compacts* the batch (power-of-
+two buckets) so stragglers don't drag finished keys' lanes along -- widening
+the per-key frontier as the batch shrinks to keep the chip busy.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..checker import jax_wgl
+from ..checker.jax_wgl import (INF32, KEYED, RUNNING, _bucket, _build_search,
+                               _encode_arrays, _plan_sizes,
+                               max_point_concurrency)
+from ..history import INF_TIME
+
+
+def _pad_key(e, init_state, spec, n_pad, S_pad, A):
+    """Pad one key's encoded arrays to the common bucket sizes."""
+    n = len(e)
+    inv32, ret32, ok_words = _encode_arrays(e)
+    fop = np.asarray(e.f, np.int32)
+    args = np.asarray(e.args, np.int32).reshape(n, -1)
+    rets = np.asarray(e.ret, np.int32).reshape(n, -1)
+    pn = n_pad - n
+    inv32 = np.concatenate([inv32, np.full(pn, INF32 - 1, np.int32)])
+    ret32 = np.concatenate([ret32, np.full(pn, INF32, np.int32)])
+    fop = np.concatenate([fop, np.zeros(pn, np.int32)])
+    args = np.concatenate([args, np.zeros((pn, A), np.int32)])
+    rets = np.concatenate([rets, np.zeros((pn, A), np.int32)])
+    extra = (n_pad + 31) // 32 - len(ok_words)
+    ok_words = np.concatenate([ok_words, np.zeros(extra, np.uint32)])
+    st = np.asarray(init_state, np.int32)
+    if len(st) < S_pad:
+        if spec.pad_state is not None:
+            st = np.asarray(spec.pad_state(st, S_pad), np.int32)
+        else:
+            raise ValueError(
+                f"model {spec.name} has varying state sizes but no pad_state")
+    return inv32, ret32, fop, args, rets, ok_words, st
+
+
+def _dummy_key(n_pad, S_pad, A):
+    """All padding rows, no ok ops: exhausts on its first iteration."""
+    return (np.full(n_pad, INF32 - 1, np.int32),
+            np.full(n_pad, INF32, np.int32),
+            np.zeros(n_pad, np.int32),
+            np.zeros((n_pad, A), np.int32),
+            np.zeros((n_pad, A), np.int32),
+            np.zeros((n_pad + 31) // 32, np.uint32),
+            np.zeros(S_pad, np.int32))
+
+
+def _shard_specs(mesh, n_carry=14, n_consts=8):
+    from jax.sharding import PartitionSpec as P
+    ax = mesh.axis_names[0]
+    carry_specs = tuple(P(ax) for _ in range(n_carry))
+    const_specs = tuple(P(ax) for _ in range(n_consts - 1)) + (P(),)
+    return carry_specs, const_specs
+
+
+def check_batch_encoded(spec, pairs, max_configs=50_000_000,
+                        chunk_iters=256, timeout_s=None, mesh=None,
+                        frontier_width=None, stack_size=None,
+                        table_size=None):
+    """Check many keys' histories at once.
+
+    ``pairs`` is a list of (EncodedHistory, init_state). Returns a list of
+    per-key result dicts (same shape as jax_wgl.check_encoded results).
+    With ``mesh`` (a 1-D ``jax.sharding.Mesh``), keys shard over its first
+    axis via shard_map; the batch is padded to a multiple of the axis size
+    with dummy keys.
+    """
+    K_real = len(pairs)
+    if K_real == 0:
+        return []
+
+    results = [None] * K_real
+    live = []
+    for k, (e, st) in enumerate(pairs):
+        if len(e) == 0 or e.n_ok == 0:
+            results[k] = {"valid": True, "configs_explored": 0}
+        else:
+            live.append(k)
+    if not live:
+        return results
+
+    # common bucket sizes across live keys
+    n_pad = _bucket(max(len(pairs[k][0]) for k in live), 64)
+    A = max(int(pairs[k][0].args.reshape(len(pairs[k][0]), -1).shape[1])
+            for k in live)
+    S_pad = max(len(pairs[k][1]) for k in live)
+    if spec.pad_state is not None:
+        S_pad = _bucket(S_pad, 2)
+    C = 4
+    for k in live:
+        e = pairs[k][0]
+        inv32, ret32, _ = _encode_arrays(e)
+        C = max(C, max_point_concurrency(
+            inv32, np.where(ret32 == INF32, INF_TIME,
+                            ret32.astype(np.int64))))
+    C = min(_bucket(C, 4), n_pad)
+
+    # shrink per-key budgets relative to single-key defaults: many keys
+    # share the chip, and a narrow per-key frontier keeps the batched
+    # search depth-first (wide frontiers degenerate to BFS over the whole
+    # config space, which is catastrophic for valid histories)
+    n_live = len(live)
+    B, W, O, T = _plan_sizes(n_pad, S_pad, C, frontier_width, stack_size,
+                             table_size)
+    if frontier_width is None:
+        W = max(32, min(W, 4096 // _bucket(n_live, 1)))
+    O = max(4096, O // _bucket(min(n_live, 8), 1))
+    max_iters = max(64, max_configs // (W * n_live))
+
+    cols = [_pad_key(pairs[k][0], pairs[k][1], spec, n_pad, S_pad, A)
+            for k in live]
+    salts = [np.uint32(k + 1) for k in live]
+    # pad the key batch with dummy keys (exhaust immediately) up to a power
+    # of two (and a multiple of the mesh axis) so compiled batch sizes are
+    # reused and compaction steps hit the same buckets
+    K = _bucket(len(cols), 1)
+    G = 1
+    if mesh is not None:
+        G = int(mesh.shape[mesh.axis_names[0]])
+        while K % G:
+            K += 1
+    while len(cols) < K:
+        cols.append(_dummy_key(n_pad, S_pad, A))
+        salts.append(np.uint32(0))
+    consts = tuple(jnp.asarray(np.stack([c[i] for c in cols]))
+                   for i in range(7)) + (jnp.asarray(np.asarray(salts)),)
+    init_states = consts[6]
+    consts = consts[:6] + (consts[7],)   # drop states, keep salt
+
+    init_carry, run_chunk = _build_search(spec.step, K, n_pad, B, S_pad, C,
+                                          A, W, O, T, G)
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+        ax = mesh.axis_names[0]
+        carry_specs, const_specs = _shard_specs(mesh)
+        # the kernel run under shard_map sees LOCAL shapes: K/G keys and
+        # one table group per device
+        _, run_local = _build_search(spec.step, K // G, n_pad, B, S_pad,
+                                     C, A, W, O, T, 1)
+        run_b = jax.jit(shard_map(
+            run_local.__wrapped__, mesh=mesh,
+            in_specs=(carry_specs,) + const_specs,
+            out_specs=carry_specs, check_vma=False),
+            donate_argnums=(0,))
+        keyed_sh = NamedSharding(mesh, P(ax))
+        consts = tuple(jax.device_put(x, keyed_sh) for x in consts)
+        carry = init_carry(init_states)
+        carry = tuple(jax.device_put(np.asarray(x), keyed_sh)
+                      for x in carry)
+    else:
+        run_b = run_chunk
+        carry = init_carry(init_states)
+
+    # alive[r] = index into `live` for batch row r, or -1 for dummy rows
+    alive = [j if j < len(live) else -1 for j in range(K)]
+    harvested = {}
+    t0 = _time.monotonic()
+    timed_out = False
+    it = 0
+
+    def harvest(rows, carry):
+        fields = {"status": carry[6], "top": carry[2], "dropped": carry[5],
+                  "explored": carry[7], "iterations": carry[11],
+                  "best_depth": carry[8], "best_lin": carry[9],
+                  "best_state": carry[10]}
+        got = jax.device_get(fields)
+        for r in rows:
+            if alive[r] >= 0:
+                harvested[alive[r]] = {k: np.asarray(v)[r]
+                                       for k, v in got.items()}
+
+    while True:
+        bound = min(it + chunk_iters, max_iters)
+        carry = run_b(carry, *consts, jnp.int32(bound))
+        it = bound
+        status = np.asarray(carry[6])
+        top = np.asarray(carry[2])
+        its = np.asarray(carry[11])
+        running = (status == RUNNING) & (top > 0) & (its < max_iters)
+        n_run = int(running.sum())
+        if n_run == 0:
+            harvest(range(len(alive)), carry)
+            break
+        if timeout_s is not None and _time.monotonic() - t0 > timeout_s:
+            timed_out = True
+            harvest(range(len(alive)), carry)
+            break
+        # Compact the batch once most keys are done: stragglers (deep
+        # exhaustion proofs) would otherwise drag every finished key's
+        # lanes through thousands more lockstep iterations. As the batch
+        # shrinks, widen the per-key frontier to keep the chip busy --
+        # carries are W-independent, so the wider kernel picks up the
+        # straggler's stack and dedup table as-is.
+        if mesh is None and len(alive) > 1 and n_run <= len(alive) // 2:
+            done_rows = [r for r in range(len(alive)) if not running[r]]
+            harvest(done_rows, carry)
+            keep = [r for r in range(len(alive)) if running[r]]
+            newK = _bucket(n_run, 1)
+            pad_row = done_rows[0]
+            idx = keep + [pad_row] * (newK - n_run)
+            sel = jnp.asarray(np.asarray(idx, np.int32))
+            carry = tuple(jnp.take(c, sel, axis=0) if i in KEYED else c
+                          for i, c in enumerate(carry))
+            consts = tuple(jnp.take(c, sel, axis=0) for c in consts)
+            alive = [alive[r] for r in keep] + [-1] * (newK - n_run)
+            W_wide = max(W, min(2048, 4096 // newK))
+            _, run_b = _build_search(spec.step, newK, n_pad, B, S_pad, C,
+                                     A, W_wide, O, T, G)
+
+    for j, k in enumerate(live):
+        per = harvested[j]
+        if (timed_out and int(per["status"]) == RUNNING
+                and int(per["top"]) > 0):
+            results[k] = {"valid": "unknown", "error": "timeout",
+                          "configs_explored": int(per["explored"]),
+                          "engine": "jax-wgl"}
+        else:
+            results[k] = jax_wgl._interpret(spec, pairs[k][0], per,
+                                            max_iters, False, pairs[k][1])
+    return results
+
+
+def check_batch_histories(spec, histories, **kw):
+    """Encode per-key event histories and check them all on device."""
+    pairs = [spec.encode(hist) for hist in histories]
+    return check_batch_encoded(spec, pairs, **kw)

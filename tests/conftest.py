@@ -5,7 +5,7 @@ multi-chip path; bench.py runs on the real chip)."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env pins the TPU
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,3 +21,10 @@ import tempfile  # noqa: E402
 _cache = os.path.join(tempfile.gettempdir(), f"jax_cache_{os.getuid()}")
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+
+# The axon sitecustomize hook overrides jax_platforms to the TPU tunnel at
+# import time; pin it back to cpu before any backend initializes so tests
+# really run on the 8-device virtual mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

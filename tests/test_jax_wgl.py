@@ -117,104 +117,16 @@ def test_fifo_queue_invalid_order():
 
 # -- randomized differential tests ------------------------------------------
 
+from jepsen_tpu.simulate import corrupt as _corrupt_impl
+from jepsen_tpu.simulate import random_history
+
+
 def _random_history(rng, spec_name, n_procs, n_ops, crash_p=0.1):
-    """Simulate a concurrent run against a real sequential object, with
-    occasional lost (info) completions -- yields histories that are mostly
-    linearizable but sometimes corrupted below."""
-    hist = []
-    if spec_name in ("register", "cas-register"):
-        state = {"v": None}
-
-        def gen_invoke(p):
-            f = rng.choice(["read", "write", "cas"]
-                           if spec_name == "cas-register"
-                           else ["read", "write"])
-            if f == "read":
-                return h.invoke_op(p, "read", None)
-            if f == "write":
-                return h.invoke_op(p, "write", rng.randrange(4))
-            return h.invoke_op(p, "cas", (rng.randrange(4), rng.randrange(4)))
-
-        def apply(inv):
-            f, v = inv["f"], inv["value"]
-            if f == "read":
-                return True, state["v"]
-            if f == "write":
-                state["v"] = v
-                return True, v
-            old, new = v
-            if state["v"] == old:
-                state["v"] = new
-                return True, v
-            return False, v
-    elif spec_name == "mutex":
-        state = {"locked": False}
-
-        def gen_invoke(p):
-            return h.invoke_op(p, rng.choice(["acquire", "release"]), None)
-
-        def apply(inv):
-            if inv["f"] == "acquire":
-                if state["locked"]:
-                    return False, None
-                state["locked"] = True
-                return True, None
-            if not state["locked"]:
-                return False, None
-            state["locked"] = False
-            return True, None
-    else:  # fifo-queue
-        state = {"q": [], "next": 0}
-
-        def gen_invoke(p):
-            if rng.random() < 0.5:
-                state["next"] += 1
-                return h.invoke_op(p, "enqueue", state["next"])
-            return h.invoke_op(p, "dequeue", None)
-
-        def apply(inv):
-            if inv["f"] == "enqueue":
-                state["q"].append(inv["value"])
-                return True, inv["value"]
-            if state["q"]:
-                return True, state["q"].pop(0)
-            return False, None
-
-    outstanding = {}
-    ops_done = 0
-    while ops_done < n_ops or outstanding:
-        free = [p for p in range(n_procs) if p not in outstanding]
-        if free and ops_done < n_ops and (not outstanding or rng.random() < .6):
-            p = rng.choice(free)
-            inv = gen_invoke(p)
-            outstanding[p] = inv
-            hist.append(inv)
-            ops_done += 1
-        else:
-            p = rng.choice(list(outstanding))
-            inv = outstanding.pop(p)
-            took_effect, res = apply(inv)
-            if rng.random() < crash_p:
-                hist.append(h.info_op(p, inv["f"], inv["value"]))
-            elif took_effect:
-                v = res if inv["f"] in ("read", "dequeue") else inv["value"]
-                hist.append(h.ok_op(p, inv["f"], v))
-            else:
-                hist.append(h.fail_op(p, inv["f"], inv["value"]))
-    return h.index(hist)
+    return random_history(rng, spec_name, n_procs, n_ops, crash_p)
 
 
 def _corrupt(rng, hist):
-    """Flip a completion value to (probably) break linearizability."""
-    hist = [h.Op(o) for o in hist]
-    cands = [i for i, o in enumerate(hist)
-             if o["type"] == "ok" and o["f"] in ("read", "dequeue")
-             and o.get("value") is not None]
-    if not cands:
-        return hist
-    i = rng.choice(cands)
-    hist[i]["value"] = (hist[i]["value"] or 0) + rng.randrange(1, 5)
-    return hist
+    return _corrupt_impl(rng, hist)
 
 
 SPECS = {"register": "register_spec", "cas-register": "cas_register_spec",

@@ -1,0 +1,249 @@
+"""Remote implementations: ssh subprocess, docker exec, kubectl exec, and
+the dummy remote used for no-cluster integration tests (reference
+jepsen/src/jepsen/control/{clj_ssh,sshj,docker,k8s}.clj and the
+{:dummy? true} path in control.clj:40).
+
+The default SSH transport shells out to the system ``ssh``/``scp``
+binaries: unlike the JVM's clj-ssh/sshj libraries there is no in-process
+SSH stack baked into this image, and subprocess ssh composes with
+ControlMaster connection pooling just as well."""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+
+from .core import Remote, env_string, escape, wrap_cd, wrap_sudo
+
+logger = logging.getLogger(__name__)
+
+
+def _run(argv, action, timeout=None):
+    try:
+        proc = subprocess.run(
+            argv, input=action.get("in", ""), capture_output=True,
+            text=True, timeout=timeout)
+        out = dict(action)
+        out.update(out=proc.stdout, err=proc.stderr, exit=proc.returncode)
+        return out
+    except subprocess.TimeoutExpired:
+        out = dict(action)
+        out.update(out="", err="timeout", exit=-1)
+        return out
+
+
+def _full_cmd(ctx, action):
+    action = dict(action)
+    action["cmd"] = wrap_cd(ctx, action["cmd"])
+    env = ctx.get("env")
+    if env:
+        action["cmd"] = f"{env_string(env)} {action['cmd']}"
+    return wrap_sudo(ctx, action)
+
+
+class SSHRemote(Remote):
+    """Runs commands through the system ssh binary; files move via scp.
+    Conn specs mirror the reference's ssh options (control.clj:40-53):
+    {"host", "port", "username", "private-key-path",
+    "strict-host-key-checking"}."""
+
+    def __init__(self, conn_spec=None):
+        self.spec = conn_spec or {}
+
+    def connect(self, conn_spec):
+        return SSHRemote(conn_spec)
+
+    def _ssh_args(self):
+        s = self.spec
+        args = ["ssh", "-o", "BatchMode=yes"]
+        if not s.get("strict-host-key-checking", False):
+            args += ["-o", "StrictHostKeyChecking=no",
+                     "-o", "UserKnownHostsFile=/dev/null"]
+        if s.get("port"):
+            args += ["-p", str(s["port"])]
+        if s.get("private-key-path"):
+            args += ["-i", s["private-key-path"]]
+        user = s.get("username", "root")
+        return args, f"{user}@{s['host']}"
+
+    def execute(self, ctx, action):
+        args, target = self._ssh_args()
+        full = _full_cmd(ctx, action)
+        return _run(args + [target, full["cmd"]], full,
+                    timeout=ctx.get("timeout"))
+
+    def _scp_args(self):
+        s = self.spec
+        args = ["scp", "-rp", "-o", "BatchMode=yes",
+                "-o", "StrictHostKeyChecking=no",
+                "-o", "UserKnownHostsFile=/dev/null"]
+        if s.get("port"):
+            args += ["-P", str(s["port"])]
+        if s.get("private-key-path"):
+            args += ["-i", s["private-key-path"]]
+        user = s.get("username", "root")
+        return args, f"{user}@{s['host']}"
+
+    def upload(self, ctx, local_paths, remote_path):
+        if isinstance(local_paths, str):
+            local_paths = [local_paths]
+        args, target = self._scp_args()
+        return _run(args + list(local_paths) + [f"{target}:{remote_path}"],
+                    {"cmd": "scp upload"})
+
+    def download(self, ctx, remote_paths, local_path):
+        if isinstance(remote_paths, str):
+            remote_paths = [remote_paths]
+        args, target = self._scp_args()
+        return _run(args + [f"{target}:{p}" for p in remote_paths]
+                    + [local_path], {"cmd": "scp download"})
+
+
+class DockerRemote(Remote):
+    """docker exec / docker cp transport (control/docker.clj)."""
+
+    def __init__(self, container=None):
+        self.container = container
+
+    def connect(self, conn_spec):
+        return DockerRemote(conn_spec.get("container",
+                                          conn_spec.get("host")))
+
+    def execute(self, ctx, action):
+        full = _full_cmd(ctx, action)
+        return _run(["docker", "exec", "-i", self.container,
+                     "bash", "-c", full["cmd"]], full,
+                    timeout=ctx.get("timeout"))
+
+    def upload(self, ctx, local_paths, remote_path):
+        if isinstance(local_paths, str):
+            local_paths = [local_paths]
+        res = None
+        for p in local_paths:
+            res = _run(["docker", "cp", p,
+                        f"{self.container}:{remote_path}"],
+                       {"cmd": "docker cp"})
+        return res
+
+    def download(self, ctx, remote_paths, local_path):
+        if isinstance(remote_paths, str):
+            remote_paths = [remote_paths]
+        res = None
+        for p in remote_paths:
+            res = _run(["docker", "cp", f"{self.container}:{p}",
+                        local_path], {"cmd": "docker cp"})
+        return res
+
+
+class K8sRemote(Remote):
+    """kubectl exec / cp transport (control/k8s.clj)."""
+
+    def __init__(self, pod=None, namespace="default"):
+        self.pod = pod
+        self.namespace = namespace
+
+    def connect(self, conn_spec):
+        return K8sRemote(conn_spec.get("pod", conn_spec.get("host")),
+                         conn_spec.get("namespace", "default"))
+
+    def execute(self, ctx, action):
+        full = _full_cmd(ctx, action)
+        return _run(["kubectl", "exec", "-i", "-n", self.namespace,
+                     self.pod, "--", "bash", "-c", full["cmd"]], full,
+                    timeout=ctx.get("timeout"))
+
+    def upload(self, ctx, local_paths, remote_path):
+        if isinstance(local_paths, str):
+            local_paths = [local_paths]
+        res = None
+        for p in local_paths:
+            res = _run(["kubectl", "cp", "-n", self.namespace, p,
+                        f"{self.pod}:{remote_path}"], {"cmd": "kubectl cp"})
+        return res
+
+    def download(self, ctx, remote_paths, local_path):
+        if isinstance(remote_paths, str):
+            remote_paths = [remote_paths]
+        res = None
+        for p in remote_paths:
+            res = _run(["kubectl", "cp", "-n", self.namespace,
+                        f"{self.pod}:{p}", local_path],
+                       {"cmd": "kubectl cp"})
+        return res
+
+
+class DummyRemote(Remote):
+    """No-op remote for logical-only tests ({:ssh {:dummy? true}},
+    control.clj:40): every command succeeds with empty output. Records
+    commands for test assertions."""
+
+    def __init__(self, host=None, log=None):
+        self.host = host
+        self.log = log if log is not None else []
+
+    def connect(self, conn_spec):
+        return DummyRemote(conn_spec.get("host"), self.log)
+
+    def execute(self, ctx, action):
+        out = _full_cmd(ctx, action)   # log what a real remote would run
+        self.log.append((self.host, out.get("cmd")))
+        out.update(out="", err="", exit=0)
+        return out
+
+    def upload(self, ctx, local_paths, remote_path):
+        self.log.append((self.host, f"upload {local_paths} {remote_path}"))
+        return {"exit": 0}
+
+    def download(self, ctx, remote_paths, local_path):
+        self.log.append((self.host,
+                         f"download {remote_paths} {local_path}"))
+        return {"exit": 0}
+
+
+class RetryRemote(Remote):
+    """Wraps a remote with bounded retry + reconnect: "SSH client libraries
+    appear to be near universally-flaky" (control/retry.clj:1-22 -- 5
+    tries, ~100 ms backoff)."""
+
+    TRIES = 5
+    BACKOFF_S = 0.1
+
+    def __init__(self, remote, conn_spec=None):
+        self.remote = remote
+        self.conn_spec = conn_spec
+        self.conn = None
+
+    def connect(self, conn_spec):
+        r = RetryRemote(self.remote, conn_spec)
+        r.conn = self.remote.connect(conn_spec)
+        return r
+
+    def disconnect(self):
+        if self.conn is not None:
+            self.conn.disconnect()
+
+    def _with_retry(self, f):
+        import time
+        last = None
+        for _ in range(self.TRIES):
+            try:
+                return f()
+            except Exception as e:  # noqa: BLE001 - flaky transports
+                last = e
+                time.sleep(self.BACKOFF_S)
+                try:
+                    self.conn = self.remote.connect(self.conn_spec)
+                except Exception:  # noqa: BLE001
+                    pass
+        raise last
+
+    def execute(self, ctx, action):
+        return self._with_retry(lambda: self.conn.execute(ctx, action))
+
+    def upload(self, ctx, local_paths, remote_path):
+        return self._with_retry(
+            lambda: self.conn.upload(ctx, local_paths, remote_path))
+
+    def download(self, ctx, remote_paths, local_path):
+        return self._with_retry(
+            lambda: self.conn.download(ctx, remote_paths, local_path))

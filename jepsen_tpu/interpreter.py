@@ -1,0 +1,237 @@
+"""The interpreter: runs a generator against real clients and a nemesis,
+producing a history (reference jepsen/src/jepsen/generator/interpreter.clj).
+
+Architecture mirrors the reference exactly: a single-threaded event loop
+plus one worker thread per logical worker (n client threads + the nemesis).
+Each worker has a 1-slot inbox; completions flow back through one shared
+queue sized to the worker count (so puts never block). The loop prioritizes
+completions (they are latency-sensitive), then asks the generator for the
+next invocation, dispatching when its scheduled time arrives
+(interpreter.clj:181-310)."""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time as _time
+
+from . import client as jclient
+from . import util
+from . import generator as gen
+
+logger = logging.getLogger(__name__)
+
+#: max µs to wait before re-polling a PENDING generator
+#: (interpreter.clj:166-170)
+MAX_PENDING_INTERVAL = 1000
+
+_EXIT = {"type": "exit"}
+
+
+class Worker:
+    """Single-threaded stateful worker (interpreter.clj:19-31)."""
+
+    def open(self, test, wid):
+        return self
+
+    def invoke(self, test, op):
+        raise NotImplementedError
+
+    def close(self, test):
+        pass
+
+
+class ClientWorker(Worker):
+    """Runs ops against (client test); crashed clients are closed and
+    reopened for the successor process unless reusable
+    (interpreter.clj:33-67)."""
+
+    def __init__(self, node):
+        self.node = node
+        self.process = None
+        self.client = None
+
+    def invoke(self, test, op):
+        if self.process != op["process"] and not (
+                self.client is not None
+                and self.client.reusable(test)):
+            self.close(test)
+            try:
+                self.client = jclient.validate(test["client"]) \
+                    .open(test, self.node)
+                self.process = op["process"]
+            except Exception as e:  # noqa: BLE001 - mirrors reference
+                logger.warning("Error opening client: %s", e)
+                self.client = None
+                out = dict(op)
+                out["type"] = "fail"
+                out["error"] = ["no-client", str(e)]
+                return out
+        else:
+            self.process = op["process"]
+        return self.client.invoke(test, op)
+
+    def close(self, test):
+        if self.client is not None:
+            self.client.close(test)
+            self.client = None
+
+
+class NemesisWorker(Worker):
+    def invoke(self, test, op):
+        return test["nemesis"].invoke(test, op)
+
+
+class ClientNemesisWorker(Worker):
+    """Spawns client workers for integer ids, nemesis workers otherwise
+    (interpreter.clj:78-95)."""
+
+    def open(self, test, wid):
+        if isinstance(wid, int):
+            nodes = test.get("nodes") or [None]
+            return ClientWorker(nodes[wid % len(nodes)])
+        return NemesisWorker()
+
+
+def goes_in_history(op):
+    """:sleep and :log ops don't belong in the history
+    (interpreter.clj:172-178)."""
+    return op.get("type") not in ("sleep", "log")
+
+
+def _spawn_worker(test, completions, worker, wid):
+    """Spawn a worker thread with a 1-slot inbox (interpreter.clj:99-164)."""
+    inbox = queue.Queue(maxsize=1)
+
+    def loop():
+        w = worker.open(test, wid)
+        try:
+            while True:
+                op = inbox.get()
+                t = op.get("type")
+                if t == "exit":
+                    return
+                try:
+                    if t == "sleep":
+                        _time.sleep(op["value"])
+                        completions.put(op)
+                    elif t == "log":
+                        logger.info("%s", op.get("value"))
+                        completions.put(op)
+                    else:
+                        out = w.invoke(test, op)
+                        completions.put(out)
+                except Exception as e:  # noqa: BLE001 - crash -> info op
+                    logger.warning("Process %r crashed: %s",
+                                   op.get("process"), e)
+                    out = dict(op)
+                    out["type"] = "info"
+                    out["exception"] = repr(e)
+                    out["error"] = f"indeterminate: {e}"
+                    completions.put(out)
+        finally:
+            w.close(test)
+
+    thread = threading.Thread(target=loop, daemon=True,
+                              name=f"jepsen worker {wid}")
+    thread.start()
+    return {"id": wid, "inbox": inbox, "thread": thread}
+
+
+def run(test):
+    """Evaluate all ops from test["generator"], dispatching to workers
+    driving test["client"] / test["nemesis"]. Returns the history
+    (interpreter.clj:181-310)."""
+    with util.ensure_relative_time():
+        return _run(test)
+
+
+def _run(test):
+    ctx = gen.context(test)
+    worker_ids = ctx.all_threads()
+    completions = queue.Queue(maxsize=len(worker_ids))
+    workers = [_spawn_worker(test, completions, ClientNemesisWorker(), wid)
+               for wid in worker_ids]
+    inboxes = {w["id"]: w["inbox"] for w in workers}
+    g = gen.validate(gen.friendly_exceptions(test.get("generator")))
+
+    outstanding = 0
+    poll_timeout = 0.0   # seconds
+    history = []
+    try:
+        while True:
+            op2 = None
+            try:
+                if poll_timeout > 0:
+                    op2 = completions.get(timeout=poll_timeout)
+                else:
+                    op2 = completions.get_nowait()
+            except queue.Empty:
+                op2 = None
+
+            if op2 is not None:
+                thread = ctx.process_to_thread(op2["process"])
+                now = util.relative_time_nanos()
+                op2 = dict(op2)
+                op2["time"] = now
+                ctx = ctx.with_time(now).free(thread)
+                g = gen.gen_update(g, test, ctx, op2)
+                if thread != gen.NEMESIS and op2.get("type") == "info":
+                    ctx = ctx.with_worker(thread, ctx.next_process(thread))
+                if goes_in_history(op2):
+                    history.append(op2)
+                outstanding -= 1
+                poll_timeout = 0.0
+                continue
+
+            now = util.relative_time_nanos()
+            ctx = ctx.with_time(now)
+            res = gen.gen_op(g, test, ctx)
+
+            if res is None:
+                if outstanding > 0:
+                    poll_timeout = MAX_PENDING_INTERVAL / 1e6
+                    continue
+                for inbox in inboxes.values():
+                    inbox.put(_EXIT)
+                for w in workers:
+                    w["thread"].join()
+                return history
+
+            op, g2 = res
+            if op is gen.PENDING:
+                # NB: do NOT commit g2 -- generator state advances only
+                # when an op is actually dispatched (the reference recurs
+                # with the old gen on :pending, interpreter.clj:264)
+                poll_timeout = MAX_PENDING_INTERVAL / 1e6
+                continue
+
+            if now < op["time"]:
+                # not yet time for this op; wait (but serve completions)
+                poll_timeout = (op["time"] - now) / 1e9
+                continue
+
+            thread = ctx.process_to_thread(op["process"])
+            inboxes[thread].put(op)
+            ctx = ctx.with_time(op["time"]).busy(thread)
+            g = gen.gen_update(g2, test, ctx, op)
+            if goes_in_history(op):
+                history.append(op)
+            outstanding += 1
+            poll_timeout = 0.0
+    except BaseException:
+        logger.info("Shutting down workers after abnormal exit")
+        # drain inboxes and ask workers to exit
+        for w in workers:
+            while w["thread"].is_alive():
+                try:
+                    w["inbox"].get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    w["inbox"].put_nowait(_EXIT)
+                    break
+                except queue.Full:
+                    continue
+        raise

@@ -41,6 +41,18 @@ def relative_time_nanos() -> int:
     return _time.monotonic_ns() - origin
 
 
+@contextlib.contextmanager
+def ensure_relative_time():
+    """Establish a relative-time origin unless one is already active (the
+    interpreter may run standalone or under core.run's origin)."""
+    global _global_origin
+    if _global_origin is not None:
+        yield
+        return
+    with with_relative_time():
+        yield
+
+
 def majority(n: int) -> int:
     """Smallest integer strictly greater than half (util.clj:84-88)."""
     return n // 2 + 1

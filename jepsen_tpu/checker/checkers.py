@@ -114,19 +114,26 @@ class Linearizable(Checker):
         return a
 
     def _competition(self, e, init_state):
-        """Race the sequential oracle against the device engine; first
-        result wins (knossos.competition semantics)."""
+        """Race the sequential oracle against the device engine; the first
+        *definite* verdict wins (knossos.competition semantics,
+        checker.clj:199-202). If the first engine to finish returns
+        "unknown" (config-budget overflow, timeout, crash), wait for the
+        other engine and prefer its verdict when definite."""
         from . import jax_wgl, wgl
-        done = threading.Event()
+        first_done = threading.Event()
         results = {}
+        order = []
+        lock = threading.Lock()
 
         def run(name, fn):
             try:
                 r = fn()
             except Exception as exc:  # noqa: BLE001
                 r = {"valid": "unknown", "error": repr(exc)}
-            results.setdefault("winner", (name, r))
-            done.set()
+            with lock:
+                results[name] = r
+                order.append(name)
+            first_done.set()
 
         # the oracle gets a config budget so it yields on hard searches
         t1 = threading.Thread(
@@ -139,15 +146,17 @@ class Linearizable(Checker):
             daemon=True)
         t1.start()
         t2.start()
-        done.wait()
-        name, r = results["winner"]
-        # an unknown from the winner defers to the loser
+        first_done.wait()
+        with lock:
+            name = order[0]
+            r = results[name]
         if r.get("valid") == "unknown":
             t1.join()
             t2.join()
-            for t in ():
-                pass
-            r2 = results.get("loser")
+            for other, r2 in results.items():
+                if other != name and r2.get("valid") != "unknown":
+                    name, r = other, r2
+                    break
         r = dict(r)
         r["engine"] = name
         return r
@@ -479,10 +488,19 @@ class _Counter(Checker):
                 if r is not None:
                     reads.append(r + [upper])
             elif key == ("invoke", "add"):
-                assert op["value"] >= 0
-                upper += op["value"]
+                v = op.get("value") or 0
+                # a pending add widens the bound in its direction; a
+                # negative add lowers the reachable floor instead
+                if v >= 0:
+                    upper += v
+                else:
+                    lower += v
             elif key == ("ok", "add"):
-                lower += op["value"]
+                v = op.get("value") or 0
+                if v >= 0:
+                    lower += v
+                else:
+                    upper += v
         errors = [r for r in reads
                   if not (r[0] <= r[1] <= r[2])]
         return {"valid": not errors, "reads": reads, "errors": errors}
@@ -502,9 +520,14 @@ class _LogFilePattern(Checker):
 
     def check(self, test, hist, opts=None):
         from .. import store
+        try:
+            paths = {node: store.path(test, node, self.filename)
+                     for node in test.get("nodes", [])}
+        except (AssertionError, KeyError):
+            return {"valid": "unknown",
+                    "error": "no store directory for this test"}
         matches = []
-        for node in test.get("nodes", []):
-            path = store.path(test, node, self.filename)
+        for node, path in paths.items():
             try:
                 with open(path, errors="replace") as f:
                     for line in f:

@@ -1,0 +1,332 @@
+"""Entry point for all tests: coordinates setup of servers, running
+workloads, injecting faults, and interpreting results (reference
+jepsen/src/jepsen/core.clj).
+
+A test is a plain dict. ``run`` nests the lifecycle exactly like the
+reference (core.clj:326-397): logging -> sessions -> OS -> DB (with log
+snarfing) -> relative-time -> run-case (client+nemesis setup/teardown
+around the interpreter) -> save-1 -> analyze (save-2) -> log-results.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import traceback
+
+from . import checker as jchecker
+from . import client as jclient
+from . import control as c
+from . import db as jdb
+from . import history as jhistory
+from . import nemesis as jnemesis
+from . import store
+from . import util
+from . import interpreter
+from .control import util as cu
+from .util import real_pmap
+
+logger = logging.getLogger(__name__)
+
+#: timeout for the synchronize barrier, seconds (core.clj:44-57)
+DEFAULT_BARRIER_TIMEOUT_S = 60
+
+NO_BARRIER = "no-barrier"
+
+
+class BarrierTimeout(TimeoutError):
+    """A "boring" exception (util.BORING_EXCEPTIONS): when one node's setup
+    breaks the barrier, the sibling nodes' timeouts must not mask the root
+    cause (core_test.clj most-interesting-exception-test)."""
+
+
+def synchronize(test, timeout_s=DEFAULT_BARRIER_TIMEOUT_S):
+    """Blocks until all nodes have arrived at the same point — used in
+    IO-heavy DB setup to phase-align nodes (core.clj:44-57)."""
+    barrier = test.get("barrier")
+    if barrier == NO_BARRIER or barrier is None:
+        return
+    if not barrier.wait(timeout_s):
+        raise BarrierTimeout(f"barrier timed out after {timeout_s}s")
+
+
+class _Barrier:
+    """A reusable cyclic barrier (java CyclicBarrier equivalent)."""
+
+    def __init__(self, parties):
+        self.parties = parties
+        self._barrier = threading.Barrier(parties)
+
+    def wait(self, timeout_s):
+        try:
+            self._barrier.wait(timeout_s)
+            return True
+        except threading.BrokenBarrierError:
+            return False
+
+
+def primary(test):
+    """The test's primary node (core.clj:66-69)."""
+    return test["nodes"][0]
+
+
+def prepare_test(test):
+    """Fills in :start-time, :concurrency, and :barrier. Always succeeds;
+    needed before accessing the test's store directory
+    (core.clj:310-324)."""
+    test = dict(test)
+    if not test.get("start-time"):
+        test["start-time"] = store.local_time()
+    if not test.get("concurrency"):
+        test["concurrency"] = len(test.get("nodes") or [])
+    if not test.get("barrier"):
+        n = len(test.get("nodes") or [])
+        test["barrier"] = _Barrier(n) if n > 0 else NO_BARRIER
+    return test
+
+
+@contextlib.contextmanager
+def with_os(test):
+    """OS setup around the body; teardown in finally (core.clj:93-100)."""
+    os_ = test.get("os")
+    try:
+        if os_ is not None:
+            c.on_nodes(test, os_.setup)
+        yield
+    finally:
+        if os_ is not None:
+            c.on_nodes(test, os_.teardown)
+
+
+def snarf_logs(test):
+    """Downloads DB log files from each node into the store dir
+    (core.clj:102-136)."""
+    db = test.get("db")
+    if not isinstance(db, jdb.LogFiles) or not test.get("name"):
+        return
+
+    def snarf(t, node):
+        paths = db.log_files(t, node) or []
+        # map full remote paths to short local names, dropping the common
+        # directory prefix (core.clj:110-117)
+        split = [str(p).split("/") for p in paths]
+        common = util.longest_common_prefix_seq(split)
+        for full, parts in zip(paths, split):
+            short = "/".join(parts[len(common):]) or parts[-1]
+            if cu.exists(full):
+                logger.info("downloading %s", full)
+                local = store.make_path(t, str(node), short.lstrip("/"))
+                try:
+                    c.download([str(full)], local)
+                except OSError as e:
+                    logger.info("%s download failed: %s", full, e)
+
+    c.on_nodes(test, snarf)
+    store.update_symlinks(test)
+
+
+def maybe_snarf_logs(test):
+    """Snarf logs, swallowing errors — used on abort paths where a snarf
+    failure must not supersede the root cause (core.clj:138-148)."""
+    try:
+        snarf_logs(test)
+    except Exception:  # noqa: BLE001
+        logger.warning("Error snarfing logs:\n%s", traceback.format_exc())
+
+
+@contextlib.contextmanager
+def with_log_snarfing(test):
+    """Ensures logs are snarfed after the body, including on errors and on
+    interpreter shutdown (core.clj:150-170)."""
+    import atexit
+    hook_done = []
+
+    def hook():
+        if not hook_done:
+            logger.info("Downloading DB logs before shutdown...")
+            maybe_snarf_logs(test)
+
+    atexit.register(hook)
+    try:
+        yield
+        snarf_logs(test)
+    finally:
+        hook_done.append(True)
+        atexit.unregister(hook)
+        maybe_snarf_logs(test)
+
+
+@contextlib.contextmanager
+def with_db(test):
+    """DB cycle (teardown->setup with retries) around the body; teardown in
+    finally unless :leave-db-running? (core.clj:173-181)."""
+    db = test.get("db")
+    try:
+        with with_log_snarfing(test):
+            if db is not None:
+                jdb.cycle(test)
+            yield
+    finally:
+        if db is not None and not test.get("leave-db-running?"):
+            c.on_nodes(test, db.teardown)
+
+
+@contextlib.contextmanager
+def with_client_nemesis_setup_teardown(test):
+    """Sets up clients (one per node, in parallel) and the nemesis (in a
+    concurrent thread) before the body; tears them down after
+    (core.clj:183-212)."""
+    client = test["client"]
+    nemesis = jnemesis.validate(test.get("nemesis") or jnemesis.noop)
+    test["nemesis"] = nemesis
+
+    nemesis_box = {}
+
+    def setup_nemesis():
+        try:
+            nemesis_box["nemesis"] = nemesis.setup(test) or nemesis
+        except Exception as e:  # noqa: BLE001
+            nemesis_box["error"] = e
+
+    nf = threading.Thread(target=setup_nemesis, name="jepsen nemesis setup")
+    nf.start()
+
+    def open_one(node):
+        cl = jclient.validate(client).open(test, node)
+        cl.setup(test)
+        return cl
+
+    clients = []
+    client_err = None
+    try:
+        clients = real_pmap(open_one, test.get("nodes") or [])
+    except Exception as e:  # noqa: BLE001
+        client_err = e
+    nf.join()
+    if "error" in nemesis_box:
+        raise nemesis_box["error"]
+    if client_err is not None:
+        raise client_err
+    test["nemesis"] = nemesis_box.get("nemesis", nemesis)
+    try:
+        yield
+    finally:
+        def teardown_nemesis():
+            test["nemesis"].teardown(test)
+
+        nt = threading.Thread(target=teardown_nemesis,
+                              name="jepsen nemesis teardown")
+        nt.start()
+
+        def close_one(cl):
+            try:
+                cl.teardown(test)
+            finally:
+                cl.close(test)
+
+        real_pmap(close_one, clients)
+        nt.join()
+
+
+def run_case(test):
+    """Spawns nemesis and clients, runs the generator, returns the history
+    (core.clj:214-219)."""
+    with with_client_nemesis_setup_teardown(test):
+        return interpreter.run(test)
+
+
+def analyze(test):
+    """Index the history, run the checker, save results
+    (core.clj:221-236)."""
+    logger.info("Analyzing...")
+    test["history"] = jhistory.index(test.get("history") or [])
+    test["results"] = jchecker.check_safe(
+        test.get("checker") or jchecker.noop(), test, test["history"])
+    logger.info("Analysis complete")
+    if test.get("name"):
+        store.save_2(test)
+    return test
+
+
+def log_results(test):
+    """Log the results map and the overall verdict (core.clj:238-251)."""
+    results = test.get("results") or {}
+    valid = results.get("valid")
+    verdict = {
+        False: "Analysis invalid! (ノಥ益ಥ）ノ ┻━┻",
+        "unknown": "Errors occurred during analysis, "
+                   "but no anomalies found. ಠ~ಠ",
+        True: "Everything looks good! ヽ('ー`)ノ",
+    }.get(valid, f"Unexpected validity {valid!r}")
+    logger.info("%s\n\n%s", results, verdict)
+    return test
+
+
+@contextlib.contextmanager
+def with_logging(test):
+    """Per-test log file around the body; logs crashes so they land in the
+    test's own log (core.clj:296-307, store.clj:431-460)."""
+    named = bool(test.get("name"))
+    try:
+        if named:
+            store.start_logging(test)
+            test["store_dir"] = store.path(test)
+        logger.info("Running test: %s", test.get("name"))
+        yield
+    except Exception:
+        logger.warning("Test crashed!\n%s", traceback.format_exc())
+        raise
+    finally:
+        if named:
+            store.stop_logging()
+
+
+@contextlib.contextmanager
+def with_sessions(test):
+    """Opens the control-plane session pool for the test's nodes
+    (core.clj:274-294)."""
+    with c.ssh_scope(test) as sessions:
+        test["sessions"] = sessions
+        try:
+            yield test
+        finally:
+            test.pop("sessions", None)
+
+
+def run(test):
+    """Runs a test end to end and returns it with :history and :results.
+
+    Tests are maps containing (core.clj:327-351):
+
+      nodes        list of node names
+      concurrency  how many client workers (default: node count)
+      ssh          credentials, or {"dummy?": True} for a no-op remote
+      os           OS protocol impl (default: none)
+      db           DB protocol impl (default: none)
+      remote       control transport override
+      client       Client protocol impl
+      nemesis      Nemesis protocol impl
+      generator    generator of operations
+      checker      verifies the history
+      name         test name (enables the store directory)
+      leave-db-running?  skip DB teardown at the end
+
+    Lifecycle (core.clj:326-397): prepare -> logging -> sessions -> os ->
+    db (+log snarfing) -> relative time -> run-case -> save-1 -> analyze
+    (save-2) -> log-results."""
+    test = prepare_test(test)
+    with with_logging(test):
+        with with_sessions(test):
+            with with_os(test):
+                with with_db(test):
+                    with util.ensure_relative_time():
+                        test["history"] = run_case(test)
+            # sessions still open: snarfing happened inside with_db
+        test.pop("barrier", None)
+        logger.info("Run complete, writing")
+        if test.get("name"):
+            store.save_1(test)
+        analyze(test)
+        log_results(test)
+    return test

@@ -223,9 +223,13 @@ def _arity2(f):
         sig = inspect.signature(f)
     except (TypeError, ValueError):
         return False
-    params = [p for p in sig.parameters.values()
-              if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
-    return len(params) >= 2
+    params = list(sig.parameters.values())
+    # NB: builtins.any — this module's `any` combinator shadows the builtin
+    if builtins.any(p.kind == p.VAR_POSITIONAL for p in params):
+        return True   # *args accepts (test, ctx); prefer the 2-arg call
+    positional = [p for p in params
+                  if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    return len(positional) >= 2
 
 
 # ---------------------------------------------------------------------------
@@ -364,8 +368,14 @@ def f_map(fm, gen):
     """Renames :f values via mapping fm (generator.clj:791-796); used to
     namespace composed nemesis generators."""
     def transform(op):
+        # ops without :f (sleep/log) pass through unchanged, as do :f
+        # values the mapping doesn't know (reference `(update op :f fm)`
+        # maps a missing key to nil rather than crashing)
+        if "f" not in op:
+            return op
         op = dict(op)
-        op["f"] = fm[op["f"]] if isinstance(fm, dict) else fm(op["f"])
+        op["f"] = fm.get(op["f"], op["f"]) if isinstance(fm, dict) \
+            else fm(op["f"])
         return op
     return Map(transform, gen)
 

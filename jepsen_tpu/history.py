@@ -165,8 +165,9 @@ def pairs(history):
 def complete(history):
     """Fill in missing invocation values from completions (knossos
     history/complete): for ok pairs, the invocation's value is replaced by the
-    completion's value (reads learn what they read). Info invocations keep
-    their value. Returns a new event list."""
+    completion's value (reads learn what they read); invocations whose
+    completion failed are marked ``fails?`` so checkers can drop the whole
+    pair. Info invocations keep their value. Returns a new event list."""
     history = ensure_indexed(history)
     out = [Op(o) for o in history]
     open_by_process = {}
@@ -179,6 +180,8 @@ def complete(history):
             j = open_by_process.pop(p, None)
             if j is not None and t == OK:
                 out[j]["value"] = o["value"]
+            elif j is not None and t == FAIL:
+                out[j]["fails?"] = True
     return out
 
 

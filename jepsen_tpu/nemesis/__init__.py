@@ -324,9 +324,13 @@ class NodeStartStopper(Nemesis):
         out["type"] = "info"
         with self.lock:
             if op["f"] == "start":
-                try:
+                # dispatch on declared arity (catching TypeError would
+                # misread a TypeError raised *inside* a 2-arg targeter as
+                # an arity mismatch and re-invoke it, duplicating effects)
+                from ..generator import _arity2
+                if _arity2(self.targeter):
                     ns = self.targeter(test, test["nodes"])
-                except TypeError:
+                else:
                     ns = self.targeter(test["nodes"])
                 if ns is None:
                     out["value"] = "no-target"

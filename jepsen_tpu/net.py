@@ -41,10 +41,16 @@ class Net:
 
 
 def _resolve_ip(node):
-    """Node hostname -> IP as seen from the control node (control/net.clj).
-    Nodes in docker-compose style clusters resolve by name; fall back to
-    the name itself."""
-    return node
+    """Node hostname -> IP as resolved *on the current node* via getent
+    (control/net.clj ip): `iptables -s <name>` resolves at rule-insert
+    time and silently matches nothing if the node's DNS view disagrees.
+    Falls back to the raw name when resolution fails (e.g. dummy
+    remotes)."""
+    from .control import net as cn
+    try:
+        return cn.ip(node)
+    except Exception:  # noqa: BLE001 - dummy remotes have no getent
+        return node
 
 
 class IPTables(Net):

@@ -1,0 +1,351 @@
+"""Persistence: writes tests, histories, and results to disk (reference
+jepsen/src/jepsen/store.clj).
+
+Layout mirrors the reference: ``store/<name>/<start-time>/`` per test run,
+with ``store/current``, ``store/latest`` and ``store/<name>/latest``
+symlinks (store.clj:118-147, 305-343). Serialization is redesigned for
+Python: the reference's Fressian binary (store.clj:31-116) becomes
+``test.json`` (the test map minus nonserializable keys, with a permissive
+encoder), and histories are written both human-readable (``history.txt``)
+and machine-readable (``history.jsonl``, one op per line — the EDN
+analogue). The two-phase model is identical: ``save_1`` persists
+test+history right after the run, before analysis; ``save_2`` re-persists
+with results (store.clj:388-413), so analysis is re-runnable offline via
+``load`` + ``load_history``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import os.path
+import shutil
+
+from . import history as h
+from .util import op_str
+
+logger = logging.getLogger(__name__)
+
+#: Root directory for all test data (store.clj:29).
+base_dir = "store"
+
+#: Test-map keys that can't (or shouldn't) be serialized
+#: (store.clj:160-162).
+DEFAULT_NONSERIALIZABLE_KEYS = {
+    "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
+    "remote", "barrier", "sessions", "dummy-log",
+}
+
+TIME_FORMAT = "%Y%m%dT%H%M%S.%f%z"
+
+
+def local_time(t=None):
+    """A start-time string: basic-date-time, local zone (util/local-time)."""
+    t = t or datetime.datetime.now().astimezone()
+    return t.strftime(TIME_FORMAT)
+
+
+def nonserializable_keys(test):
+    """Default nonserializable keys plus the test's own
+    (store.clj:164-168)."""
+    return DEFAULT_NONSERIALIZABLE_KEYS | set(
+        test.get("nonserializable-keys", ()))
+
+
+def path(test, *args):
+    """The directory for a test's results, or a file inside it. Nested
+    list path components are flattened; Nones are dropped
+    (store.clj:118-139)."""
+    assert test.get("name"), "test needs a :name to have a store directory"
+    assert test.get("start-time"), "test needs a :start-time"
+    t = test["start-time"]
+    if not isinstance(t, str):
+        t = local_time(t)
+
+    def flatten(xs):
+        for x in xs:
+            if x is None:
+                continue
+            if isinstance(x, (list, tuple)):
+                yield from flatten(x)
+            else:
+                yield str(x)
+
+    return os.path.join(base_dir, str(test["name"]), t, *flatten(args))
+
+
+def make_path(test, *args):
+    """Like path, but ensures the containing directory exists
+    (store.clj:142-147)."""
+    p = path(test, *args)
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    return p
+
+
+class _Encoder(json.JSONEncoder):
+    """Permissive JSON encoder: sets become sorted lists, datetimes
+    ISO-format, everything else falls back to repr (the analogue of the
+    reference's custom fressian handlers, store.clj:31-116)."""
+
+    def default(self, o):
+        if isinstance(o, (set, frozenset)):
+            try:
+                return sorted(o)
+            except TypeError:
+                return sorted(o, key=repr)
+        if isinstance(o, (datetime.datetime, datetime.date)):
+            return o.isoformat()
+        if isinstance(o, bytes):
+            return o.decode("utf-8", errors="replace")
+        try:
+            import numpy as np
+            if isinstance(o, np.integer):
+                return int(o)
+            if isinstance(o, np.floating):
+                return float(o)
+            if isinstance(o, np.ndarray):
+                return o.tolist()
+        except ImportError:  # pragma: no cover
+            pass
+        return repr(o)
+
+
+def _dump_json(data, p):
+    tmp = p + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1, cls=_Encoder)
+        f.write("\n")
+    os.replace(tmp, p)
+
+
+def serializable_test(test):
+    return {k: v for k, v in test.items()
+            if k not in nonserializable_keys(test)}
+
+
+def write_results(test):
+    """Writes results.json (store.clj:354-358 results.edn)."""
+    _dump_json(test.get("results"), make_path(test, "results.json"))
+
+
+def write_history(test):
+    """Writes history.txt (human) and history.jsonl (machine)
+    (store.clj:360-371)."""
+    hist = test.get("history") or []
+    with open(make_path(test, "history.txt"), "w") as f:
+        for op in hist:
+            f.write(op_str(op) + "\n")
+    with open(make_path(test, "history.jsonl"), "w") as f:
+        for op in hist:
+            f.write(json.dumps(op, cls=_Encoder) + "\n")
+
+
+def write_test(test):
+    """Writes the serializable test map as test.json (the fressian
+    analogue, store.clj:382-386)."""
+    t = dict(serializable_test(test))
+    t.pop("history", None)   # stored separately as history.jsonl
+    t.pop("results", None)   # stored separately as results.json
+    _dump_json(t, make_path(test, "test.json"))
+
+
+def update_symlink(test, dest_parts):
+    """Symlink base_dir/<dest_parts> -> the test directory
+    (store.clj:316-327)."""
+    src = path(test)
+    if not os.path.exists(src):
+        return
+    dest = os.path.join(base_dir, *dest_parts)
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    try:
+        if os.path.islink(dest) or os.path.exists(dest):
+            os.remove(dest)
+        os.symlink(os.path.relpath(src, os.path.dirname(dest)), dest)
+    except OSError as e:  # pragma: no cover - symlink-less filesystems
+        logger.warning("couldn't update symlink %s: %s", dest, e)
+
+
+def update_current_symlink(test):
+    update_symlink(test, ["current"])
+
+
+def update_symlinks(test):
+    """current, latest, and <name>/latest (store.clj:335-343)."""
+    for dest in (["current"], ["latest"], [str(test["name"]), "latest"]):
+        update_symlink(test, dest)
+
+
+def save_1(test):
+    """Phase 1: history + test map, right after the run and before analysis
+    (store.clj:388-399). Returns test."""
+    write_history(test)
+    write_test(test)
+    update_symlinks(test)
+    return test
+
+
+def save_2(test):
+    """Phase 2: after computing results, re-write everything plus
+    results.json (store.clj:401-413). Returns test."""
+    write_results(test)
+    write_history(test)
+    write_test(test)
+    update_symlinks(test)
+    return test
+
+
+# ---------------------------------------------------------------------------
+# loading
+
+def load(test_name, test_time):
+    """Loads a stored test by name and time: the test map with its history
+    re-attached, for offline re-analysis (store.clj:193-197)."""
+    test = {"name": test_name, "start-time": test_time}
+    with open(path(test, "test.json")) as f:
+        out = json.load(f)
+    out["history"] = load_history(test)
+    try:
+        out["results"] = load_results(test_name, test_time)
+    except FileNotFoundError:
+        pass
+    return out
+
+
+def load_history(test):
+    hist = []
+    try:
+        with open(path(test, "history.jsonl")) as f:
+            for line in f:
+                if line.strip():
+                    hist.append(h.Op(json.loads(line)))
+    except FileNotFoundError:
+        pass
+    return hist
+
+
+def load_results(test_name, test_time):
+    """Loads the results map (store.clj:241-248)."""
+    with open(path({"name": test_name, "start-time": test_time},
+                   "results.json")) as f:
+        return json.load(f)
+
+
+_results_cache = {}
+
+
+def memoized_load_results(test_name, test_time):
+    key = (test_name, test_time)
+    if key not in _results_cache:
+        _results_cache[key] = load_results(test_name, test_time)
+    return _results_cache[key]
+
+
+# ---------------------------------------------------------------------------
+# browsing
+
+def test_names():
+    """All test names in the store (store.clj:274-282)."""
+    try:
+        return sorted(
+            d for d in os.listdir(base_dir)
+            if os.path.isdir(os.path.join(base_dir, d))
+            and not os.path.islink(os.path.join(base_dir, d))
+            and d not in ("latest", "current"))
+    except FileNotFoundError:
+        return []
+
+
+def tests(test_name=None):
+    """{name: {time: loader}} or {time: loader} for one name
+    (store.clj:284-303). Loaders are zero-arg callables."""
+    if test_name is None:
+        return {n: tests(n) for n in test_names()}
+    d = os.path.join(base_dir, str(test_name))
+    out = {}
+    try:
+        entries = os.listdir(d)
+    except FileNotFoundError:
+        return out
+    for t in sorted(entries):
+        full = os.path.join(d, t)
+        if os.path.isdir(full) and not os.path.islink(full) \
+                and t != "latest":
+            out[t] = (lambda n=test_name, tt=t: load(n, tt))
+    return out
+
+
+def latest():
+    """Loads the latest test (store.clj:305-314)."""
+    link = os.path.join(base_dir, "latest")
+    if not os.path.exists(link):
+        return None
+    target = os.path.realpath(link)
+    time_part = os.path.basename(target)
+    name_part = os.path.basename(os.path.dirname(target))
+    return load(name_part, time_part)
+
+
+def delete(test_name=None, test_time=None):
+    """Deletes all tests, one name, or one run (store.clj:470-478)."""
+    if test_name is None:
+        shutil.rmtree(base_dir, ignore_errors=True)
+    elif test_time is None:
+        shutil.rmtree(os.path.join(base_dir, str(test_name)),
+                      ignore_errors=True)
+    else:
+        shutil.rmtree(path({"name": test_name, "start-time": test_time}),
+                      ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# per-test logging (store.clj:415-460)
+
+_log_handler = None
+
+LOG_PATTERN = "%(asctime)s\t%(levelname)s\t[%(threadName)s] %(name)s: " \
+              "%(message)s"
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record):
+        return json.dumps({
+            "timestamp": self.formatTime(record),
+            "level": record.levelname,
+            "thread": record.threadName,
+            "logger": record.name,
+            "message": record.getMessage(),
+        })
+
+
+def start_logging(test):
+    """Starts logging to jepsen.log in the test's directory; updates the
+    current symlink (store.clj:431-452). :logging-json? selects JSON
+    structured logs."""
+    global _log_handler
+    stop_logging()
+    handler = logging.FileHandler(make_path(test, "jepsen.log"))
+    if test.get("logging-json?"):
+        handler.setFormatter(_JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(LOG_PATTERN))
+    overrides = (test.get("logging") or {}).get("overrides", {})
+    for pkg, level in overrides.items():
+        logging.getLogger(pkg).setLevel(
+            getattr(logging, str(level).upper(), logging.INFO))
+    root = logging.getLogger()
+    if root.level > logging.INFO or root.level == logging.NOTSET:
+        root.setLevel(logging.INFO)
+    root.addHandler(handler)
+    _log_handler = handler
+    update_current_symlink(test)
+
+
+def stop_logging():
+    """Removes the per-test log file handler (store.clj:453-460)."""
+    global _log_handler
+    if _log_handler is not None:
+        logging.getLogger().removeHandler(_log_handler)
+        _log_handler.close()
+        _log_handler = None

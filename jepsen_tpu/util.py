@@ -70,24 +70,34 @@ def minority_third(n: int) -> int:
     return max(0, (n - 1) // 3)
 
 
+#: Exception types that usually mask the root cause when a sibling thread
+#: dies first (dom-top real-pmap rethrows the *interesting* one;
+#: core_test.clj most-interesting-exception-test).
+BORING_EXCEPTIONS = (threading.BrokenBarrierError, InterruptedError,
+                     TimeoutError)
+
+
 def real_pmap(f, coll):
-    """Map f over coll in parallel, one thread per element; raises the first
-    exception raised by any element (util.clj:65-77 via dom-top)."""
+    """Map f over coll in parallel, one thread per element; raises the most
+    *interesting* exception raised by any element — barrier/interrupt
+    errors are secondary to real failures (util.clj:65-77 via dom-top)."""
     coll = list(coll)
     if not coll:
         return []
     with concurrent.futures.ThreadPoolExecutor(max_workers=len(coll)) as ex:
         futures = [ex.submit(f, x) for x in coll]
         results = []
-        first_err = None
+        errs = []
         for fut in futures:
             try:
                 results.append(fut.result())
-            except BaseException as e:  # noqa: BLE001 - propagate first error
-                if first_err is None:
-                    first_err = e
-        if first_err is not None:
-            raise first_err
+            except BaseException as e:  # noqa: BLE001 - collect, pick best
+                errs.append(e)
+        if errs:
+            for e in errs:
+                if not isinstance(e, BORING_EXCEPTIONS):
+                    raise e
+            raise errs[0]
         return results
 
 
@@ -153,6 +163,25 @@ def longest_common_prefix(strings):
         if c != s2[i]:
             return s1[:i]
     return s1
+
+
+def longest_common_prefix_seq(seqs):
+    """Longest common prefix of a collection of sequences, as a list —
+    used to shorten snarfed log paths (util.clj drop-common-proper-prefix).
+    Always leaves at least the last element distinct (proper prefix)."""
+    seqs = [list(s) for s in seqs]
+    if not seqs:
+        return []
+    prefix = []
+    for items in zip(*seqs):
+        if all(x == items[0] for x in items):
+            prefix.append(items[0])
+        else:
+            break
+    shortest = min(len(s) for s in seqs)
+    if prefix and len(prefix) >= shortest:
+        prefix = prefix[:shortest - 1]
+    return prefix
 
 
 def op_str(o) -> str:

@@ -1,0 +1,570 @@
+"""Golden-history tests for the checker library.
+
+Mirrors the coverage of reference
+jepsen/test/jepsen/checker_test.clj:18-682 — hand-written histories in,
+verdict maps out — plus the competition unknown-winner path
+(checker.clj:199-202 semantics) that round 1 shipped untested.
+"""
+
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import history as h
+from jepsen_tpu import models
+from jepsen_tpu.checker import checkers as ck
+from jepsen_tpu.checker import core as cc
+
+inv = h.invoke_op
+ok = h.ok_op
+
+
+def fail(process, f, value=None, **kw):
+    return h.op("fail", process, f, value, **kw)
+
+
+def info(process, f, value=None, **kw):
+    return h.op("info", process, f, value, **kw)
+
+
+def check(checker, hist, test=None, opts=None):
+    return cc.check(checker, test or {}, hist, opts)
+
+
+# ---------------------------------------------------------------------------
+# unhandled-exceptions (checker_test.clj:17-42)
+
+def test_unhandled_exceptions():
+    r = check(ck.unhandled_exceptions(), [
+        inv(0, "foo", 1),
+        info(0, "foo", 1, exception="IllegalArgumentException"),
+        inv(0, "foo", 1),
+        info(0, "foo", 1, exception="IllegalArgumentException"),
+        inv(0, "foo", 1),
+        info(0, "foo", 1, exception="IllegalStateException"),
+    ])
+    assert r["valid"] is True
+    assert [e["count"] for e in r["exceptions"]] == [2, 1]
+    assert r["exceptions"][0]["class"] == "IllegalArgumentException"
+
+
+def test_unhandled_exceptions_empty():
+    r = check(ck.unhandled_exceptions(), [])
+    assert r == {"valid": True}
+
+
+# ---------------------------------------------------------------------------
+# stats (checker_test.clj:44-67)
+
+def test_stats():
+    r = check(ck.stats(), [
+        h.op("ok", 0, "foo"),
+        h.op("fail", 0, "foo"),
+        h.op("info", 0, "bar"),
+        h.op("fail", 0, "bar"),
+        h.op("fail", 0, "bar"),
+    ])
+    assert r["valid"] is False
+    assert r["count"] == 5
+    assert r["ok-count"] == 1
+    assert r["fail-count"] == 3
+    assert r["info-count"] == 1
+    assert r["by-f"]["foo"]["valid"] is True
+    assert r["by-f"]["foo"]["count"] == 2
+    assert r["by-f"]["bar"]["valid"] is False
+    assert r["by-f"]["bar"]["info-count"] == 1
+
+
+def test_stats_ignores_invokes_and_nemesis():
+    r = check(ck.stats(), [
+        inv(0, "w", 1),
+        h.op("info", "nemesis", "start"),
+        ok(0, "w", 1),
+    ])
+    assert r["valid"] is True
+    assert r["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# queue (checker_test.clj:69-88)
+
+def test_queue_empty():
+    assert check(ck.queue(None), [])["valid"] is True
+
+
+def test_queue_possible_enqueue_no_dequeue():
+    r = check(ck.queue(models.unordered_queue()), [inv(1, "enqueue", 1)])
+    assert r["valid"] is True
+
+
+def test_queue_definite_enqueue_no_dequeue():
+    r = check(ck.queue(models.unordered_queue()), [ok(1, "enqueue", 1)])
+    assert r["valid"] is True
+
+
+def test_queue_concurrent_enqueue_dequeue():
+    r = check(ck.queue(models.unordered_queue()), [
+        inv(2, "dequeue"),
+        inv(1, "enqueue", 1),
+        ok(2, "dequeue", 1),
+    ])
+    assert r["valid"] is True
+
+
+def test_queue_dequeue_without_enqueue():
+    r = check(ck.queue(models.unordered_queue()), [ok(1, "dequeue", 1)])
+    assert r["valid"] is False
+
+
+# ---------------------------------------------------------------------------
+# total-queue (checker_test.clj:90-143)
+
+def test_total_queue_sane():
+    r = check(ck.total_queue(), [
+        inv(1, "enqueue", 1),
+        inv(2, "enqueue", 2),
+        ok(2, "enqueue", 2),
+        inv(3, "dequeue", 1),
+        ok(3, "dequeue", 1),
+        inv(3, "dequeue", 2),
+        ok(3, "dequeue", 2),
+    ])
+    assert r["valid"] is True
+    assert r["attempt-count"] == 2
+    assert r["acknowledged-count"] == 1
+    assert r["ok-count"] == 2
+    assert r["recovered-count"] == 1
+    assert r["recovered"] == {1: 1}
+    assert r["lost-count"] == 0
+
+
+def test_total_queue_pathological():
+    r = check(ck.total_queue(), [
+        inv(1, "enqueue", "hung"),
+        inv(2, "enqueue", "enqueued"),
+        ok(2, "enqueue", "enqueued"),
+        inv(3, "enqueue", "dup"),
+        ok(3, "enqueue", "dup"),
+        inv(4, "dequeue"),      # hangs
+        inv(5, "dequeue"),
+        ok(5, "dequeue", "wtf"),
+        inv(6, "dequeue"),
+        ok(6, "dequeue", "dup"),
+        inv(7, "dequeue"),
+        ok(7, "dequeue", "dup"),
+    ])
+    assert r["valid"] is False
+    assert r["lost"] == {"enqueued": 1}
+    assert r["unexpected"] == {"wtf": 1}
+    assert r["duplicated"] == {"dup": 1}
+    assert r["attempt-count"] == 3
+    assert r["acknowledged-count"] == 2
+    assert r["ok-count"] == 1
+
+
+def test_expand_queue_drain_ops():
+    hist = [
+        inv(1, "drain"),
+        ok(1, "drain", [1, 2]),
+    ]
+    out = ck.expand_queue_drain_ops(hist)
+    assert [(o["type"], o["f"], o.get("value")) for o in out] == [
+        ("invoke", "dequeue", None), ("ok", "dequeue", 1),
+        ("invoke", "dequeue", None), ("ok", "dequeue", 2)]
+
+
+# ---------------------------------------------------------------------------
+# counter (checker_test.clj:145-222)
+
+def test_counter_empty():
+    assert check(ck.counter(), []) == {"valid": True, "reads": [],
+                                       "errors": []}
+
+
+def test_counter_initial_read():
+    r = check(ck.counter(), [inv(0, "read"), ok(0, "read", 0)])
+    assert r == {"valid": True, "reads": [[0, 0, 0]], "errors": []}
+
+
+def test_counter_ignores_failed_ops():
+    r = check(ck.counter(), [
+        inv(0, "add", 1),
+        fail(0, "add", 1),
+        inv(0, "read"),
+        ok(0, "read", 0),
+    ])
+    assert r == {"valid": True, "reads": [[0, 0, 0]], "errors": []}
+
+
+def test_counter_initial_invalid_read():
+    r = check(ck.counter(), [inv(0, "read"), ok(0, "read", 1)])
+    assert r == {"valid": False, "reads": [[0, 1, 0]],
+                 "errors": [[0, 1, 0]]}
+
+
+def test_counter_interleaved():
+    r = check(ck.counter(), [
+        inv(0, "read"),
+        inv(1, "add", 1),
+        inv(2, "read"),
+        inv(3, "add", 2),
+        inv(4, "read"),
+        inv(5, "add", 4),
+        inv(6, "read"),
+        inv(7, "add", 8),
+        inv(8, "read"),
+        ok(0, "read", 6),
+        ok(1, "add", 1),
+        ok(2, "read", 0),
+        ok(3, "add", 2),
+        ok(4, "read", 3),
+        ok(5, "add", 4),
+        ok(6, "read", 100),
+        ok(7, "add", 8),
+        ok(8, "read", 15),
+    ])
+    assert r["valid"] is False
+    assert r["reads"] == [[0, 6, 15], [0, 0, 15], [0, 3, 15],
+                          [0, 100, 15], [0, 15, 15]]
+    assert r["errors"] == [[0, 100, 15]]
+
+
+def test_counter_rolling():
+    r = check(ck.counter(), [
+        inv(0, "read"),
+        inv(1, "add", 1),
+        ok(0, "read", 0),
+        inv(0, "read"),
+        ok(1, "add", 1),
+        inv(1, "add", 2),
+        ok(0, "read", 3),
+        inv(0, "read"),
+        ok(1, "add", 2),
+        ok(0, "read", 5),
+    ])
+    assert r["valid"] is False
+    assert r["reads"] == [[0, 0, 1], [0, 3, 3], [1, 5, 3]]
+    assert r["errors"] == [[1, 5, 3]]
+
+
+def test_counter_negative_adds_no_crash():
+    # the reference returns verdicts, never raises, on odd histories
+    r = check(ck.counter(), [
+        inv(0, "add", -3),
+        ok(0, "add", -3),
+        inv(0, "read"),
+        ok(0, "read", -3),
+    ])
+    assert r["valid"] is True
+
+
+# ---------------------------------------------------------------------------
+# set (checker.clj:240-291)
+
+def test_set_never_read():
+    r = check(ck.set_checker(), [inv(0, "add", 1), ok(0, "add", 1)])
+    assert r["valid"] == "unknown"
+
+
+def test_set_lost_and_unexpected():
+    r = check(ck.set_checker(), [
+        inv(0, "add", 0),
+        ok(0, "add", 0),
+        inv(0, "add", 1),
+        ok(0, "add", 1),
+        inv(1, "add", 2),      # attempted, never acked
+        info(1, "add", 2),
+        inv(0, "read"),
+        ok(0, "read", [0, 2, 99]),   # 1 lost, 99 unexpected, 2 recovered
+    ])
+    assert r["valid"] is False
+    assert r["lost"] == [1]
+    assert r["unexpected"] == [99]
+    assert r["recovered"] == [2]
+    assert r["attempt-count"] == 3
+    assert r["acknowledged-count"] == 2
+
+
+def test_set_valid():
+    r = check(ck.set_checker(), [
+        inv(0, "add", 1),
+        ok(0, "add", 1),
+        inv(0, "read"),
+        ok(0, "read", [1]),
+    ])
+    assert r["valid"] is True
+
+
+# ---------------------------------------------------------------------------
+# set-full (checker.clj:294-592; checker_test.clj set-full-test)
+
+def _t(o, t):
+    o = dict(o)
+    o["time"] = t
+    return o
+
+
+def test_set_full_stable():
+    r = check(ck.set_full(), [
+        _t(inv(0, "add", 0), 0),
+        _t(ok(0, "add", 0), 1),
+        _t(inv(1, "read"), 2),
+        _t(ok(1, "read", [0]), 3),
+    ])
+    assert r["valid"] is True
+    assert r["stable-count"] == 1
+    assert r["lost-count"] == 0
+
+
+def test_set_full_lost():
+    r = check(ck.set_full(), [
+        _t(inv(0, "add", 0), 0),
+        _t(ok(0, "add", 0), 1),
+        _t(inv(1, "read"), 2),
+        _t(ok(1, "read", [0]), 3),
+        _t(inv(1, "read"), 4),
+        _t(ok(1, "read", []), 5),    # later read loses it
+    ])
+    assert r["valid"] is False
+    assert r["lost"] == [0]
+
+
+def test_set_full_never_read_unknown():
+    r = check(ck.set_full(), [
+        _t(inv(0, "add", 0), 0),
+        _t(ok(0, "add", 0), 1),
+    ])
+    assert r["valid"] == "unknown"
+
+
+def test_set_full_duplicate_invalid():
+    r = check(ck.set_full(), [
+        _t(inv(0, "add", 0), 0),
+        _t(ok(0, "add", 0), 1),
+        _t(inv(1, "read"), 2),
+        _t(ok(1, "read", [0, 0]), 3),
+    ])
+    assert r["valid"] is False
+    assert r["duplicated"] == {0: 2}
+
+
+def test_set_full_linearizable_stale():
+    # element visible only *after* an absent read that begins after the
+    # add completed -> stale under linearizable mode
+    ms = 1_000_000  # history times are nanoseconds; latencies are in ms
+    hist = [
+        _t(inv(0, "add", 0), 0 * ms),
+        _t(ok(0, "add", 0), 10 * ms),
+        _t(inv(1, "read"), 20 * ms),
+        _t(ok(1, "read", []), 30 * ms),      # absent after ack: stale
+        _t(inv(1, "read"), 40 * ms),
+        _t(ok(1, "read", [0]), 50 * ms),
+    ]
+    r = check(ck.set_full({"linearizable?": True}), hist)
+    assert r["valid"] is False
+    assert r["stale"] == [0]
+    r2 = check(ck.set_full(), hist)
+    assert r2["valid"] is True   # eventually-consistent mode tolerates it
+
+
+# ---------------------------------------------------------------------------
+# unique-ids (checker.clj:689-734)
+
+def test_unique_ids_ok():
+    r = check(ck.unique_ids(), [
+        inv(0, "generate"),
+        ok(0, "generate", 0),
+        inv(0, "generate"),
+        ok(0, "generate", 1),
+    ])
+    assert r["valid"] is True
+    assert r["attempted-count"] == 2
+    assert r["acknowledged-count"] == 2
+    assert r["range"] == [0, 1]
+
+
+def test_unique_ids_dup():
+    r = check(ck.unique_ids(), [
+        inv(0, "generate"),
+        ok(0, "generate", 0),
+        inv(0, "generate"),
+        ok(0, "generate", 0),
+    ])
+    assert r["valid"] is False
+    assert r["duplicated"] == {0: 2}
+
+
+# ---------------------------------------------------------------------------
+# log-file-pattern (checker.clj:839-881)
+
+def test_log_file_pattern(tmp_path, monkeypatch):
+    from jepsen_tpu import store
+    monkeypatch.setattr(store, "base_dir", str(tmp_path))
+    ts = "20260729T000000.000000+0000"
+    test = {"name": "lfp", "start-time": ts, "nodes": ["n1", "n2"]}
+    node_dir = tmp_path / "lfp" / ts / "n1"
+    node_dir.mkdir(parents=True)
+    (node_dir / "db.log").write_text("ok line\npanic: boom\nok line\n")
+    r = check(ck.log_file_pattern(r"panic", "db.log"), [], test=test)
+    assert r["valid"] is False
+    assert r["count"] == 1
+    assert r["matches"] == [{"node": "n1", "line": "panic: boom"}]
+
+
+def test_log_file_pattern_no_store():
+    r = check(ck.log_file_pattern(r"panic", "db.log"), [],
+              test={"nodes": ["n1"]})
+    assert r["valid"] == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# compose / check-safe / merge-valid / concurrency-limit
+# (checker_test.clj:224-229)
+
+def test_compose():
+    r = check(cc.compose({"a": cc.unbridled_optimism(),
+                          "b": cc.unbridled_optimism()}), [])
+    assert r["valid"] is True
+    assert r["a"]["valid"] is True
+    assert r["b"]["valid"] is True
+
+
+def test_compose_merges_worst():
+    class Bad(cc.Checker):
+        def check(self, test, hist, opts=None):
+            return {"valid": False}
+
+    r = check(cc.compose({"good": cc.noop(), "bad": Bad()}), [])
+    assert r["valid"] is False
+
+
+def test_check_safe_catches():
+    class Boom(cc.Checker):
+        def check(self, test, hist, opts=None):
+            raise RuntimeError("boom")
+
+    r = cc.check_safe(Boom(), {}, [])
+    assert r["valid"] == "unknown"
+    assert "boom" in r["error"]
+
+
+def test_merge_valid():
+    assert cc.merge_valid([True, True]) is True
+    assert cc.merge_valid([True, "unknown"]) == "unknown"
+    assert cc.merge_valid([False, "unknown", True]) is False
+    assert cc.merge_valid([]) is True
+
+
+def test_concurrency_limit():
+    active = []
+    peak = []
+    lock = threading.Lock()
+
+    class Slow(cc.Checker):
+        def check(self, test, hist, opts=None):
+            with lock:
+                active.append(1)
+                peak.append(len(active))
+            time.sleep(0.02)
+            with lock:
+                active.pop()
+            return {"valid": True}
+
+    limited = cc.concurrency_limit(2, Slow(), key="test-limit")
+    threads = [threading.Thread(target=limited.check, args=({}, []))
+               for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert max(peak) <= 2
+
+
+# ---------------------------------------------------------------------------
+# linearizable gate (checker.clj:185-216)
+
+GOOD_CAS = [
+    inv(0, "write", 1),
+    ok(0, "write", 1),
+    inv(1, "read"),
+    ok(1, "read", 1),
+    inv(0, "cas", [1, 2]),
+    ok(0, "cas", [1, 2]),
+    inv(1, "read"),
+    ok(1, "read", 2),
+]
+
+BAD_CAS = [
+    inv(0, "write", 1),
+    ok(0, "write", 1),
+    inv(1, "read"),
+    ok(1, "read", 7),     # never written
+]
+
+
+@pytest.mark.parametrize("algo", ["wgl", "linear", "jax-wgl", "competition"])
+def test_linearizable_verdicts(algo):
+    c = ck.linearizable({"model": "cas-register", "algorithm": algo})
+    assert check(c, GOOD_CAS)["valid"] is True
+    assert check(c, BAD_CAS)["valid"] is False
+
+
+def test_linearizable_requires_model():
+    with pytest.raises(Exception):
+        ck.linearizable({"model": None})
+
+
+def test_linearizable_ignores_nemesis_ops():
+    hist = [h.op("info", "nemesis", "start")] + GOOD_CAS + \
+           [h.op("info", "nemesis", "stop")]
+    c = ck.linearizable({"model": "cas-register", "algorithm": "wgl"})
+    assert check(c, hist)["valid"] is True
+
+
+def test_competition_unknown_winner_defers_to_loser(monkeypatch):
+    """If the first engine to finish returns unknown, competition must wait
+    for the other and take its definite verdict (checker.clj:199-202)."""
+    from jepsen_tpu.checker import jax_wgl, wgl
+
+    def fast_unknown(spec, e, init_state, **kw):
+        return {"valid": "unknown", "error": "budget"}
+
+    real = wgl.check_encoded
+
+    def slow_definite(spec, e, init_state, **kw):
+        kw.pop("max_configs", None)
+        time.sleep(0.05)
+        return real(spec, e, init_state)
+
+    monkeypatch.setattr(jax_wgl, "check_encoded", fast_unknown)
+    monkeypatch.setattr(wgl, "check_encoded", slow_definite)
+    c = ck.linearizable({"model": "cas-register"})
+    r = check(c, GOOD_CAS)
+    assert r["valid"] is True
+    assert r["engine"] == "wgl"
+
+
+def test_competition_both_unknown(monkeypatch):
+    from jepsen_tpu.checker import jax_wgl, wgl
+
+    def unknown(spec, e, init_state, **kw):
+        return {"valid": "unknown", "error": "budget"}
+
+    monkeypatch.setattr(jax_wgl, "check_encoded", unknown)
+    monkeypatch.setattr(wgl, "check_encoded", unknown)
+    c = ck.linearizable({"model": "cas-register"})
+    r = check(c, GOOD_CAS)
+    assert r["valid"] == "unknown"
+
+
+def test_linearizable_truncates_final_ops(monkeypatch):
+    from jepsen_tpu.checker import wgl
+
+    def fat(spec, e, init_state, **kw):
+        return {"valid": False, "final_ops": list(range(50))}
+
+    monkeypatch.setattr(wgl, "check_encoded", fat)
+    c = ck.linearizable({"model": "cas-register", "algorithm": "wgl"})
+    r = check(c, BAD_CAS)
+    assert len(r["final_ops"]) == 10

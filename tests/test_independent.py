@@ -1,0 +1,198 @@
+"""Tests for jepsen_tpu.independent: tuples, sequential/concurrent
+generators, and the per-key checker with its batched device fast path
+(reference independent.clj + independent_test.clj semantics)."""
+
+import pytest
+
+from jepsen_tpu import checker as cc
+from jepsen_tpu import generator as gen
+from jepsen_tpu import history as h
+from jepsen_tpu import independent
+from jepsen_tpu.checker import checkers as ck
+from jepsen_tpu.generator import testing as gt
+
+inv = h.invoke_op
+ok = h.ok_op
+T = independent.tuple_
+
+
+def test_tuple():
+    t = T("k", 5)
+    assert independent.is_tuple(t)
+    assert t.key == "k" and t.value == 5
+    assert not independent.is_tuple(("k", 5))
+    assert not independent.is_tuple([1, 2])
+    assert list(t) == ["k", 5]   # serializes like a 2-list
+
+
+def test_sequential_generator():
+    g = independent.sequential_generator(
+        [0, 1], lambda k: gen.limit(2, gen.repeat({"f": "w", "value": "x"})))
+    hist = [o for o in gt.quick(gen.clients(g)) if h.invoke(o)]
+    vals = [o["value"] for o in hist]
+    assert vals == [T(0, "x"), T(0, "x"), T(1, "x"), T(1, "x")]
+
+
+def test_history_keys_and_subhistory():
+    hist = [
+        inv(0, "w", T("a", 1)),
+        h.op("info", "nemesis", "start", "whoops"),
+        ok(0, "w", T("a", 1)),
+        inv(1, "w", T("b", 2)),
+        ok(1, "w", T("b", 2)),
+    ]
+    assert independent.history_keys(hist) == {"a", "b"}
+    sub = independent.subhistory("a", hist)
+    # unkeyed nemesis op appears; key b's ops don't; values unwrapped
+    assert [o.get("value") for o in sub] == [1, "whoops", 1]
+
+
+def test_concurrent_generator_groups_and_rotation():
+    """2 threads per key over 4 worker threads: two keys in flight;
+    exhausted groups rotate to fresh keys (independent.clj:103-236)."""
+    n_per_key = 2
+    g = independent.concurrent_generator(
+        n_per_key, range(10),
+        lambda k: gen.limit(3, gen.repeat({"f": "w", "value": k})))
+    test = {"concurrency": 4, "nodes": ["n1", "n2"]}
+    hist = gt.simulate(test, g, gt.perfect)
+    invs = [o for o in hist if h.invoke(o)]
+    # every op carries a tuple value wrapping its key
+    assert all(independent.is_tuple(o["value"]) for o in invs)
+    by_key = {}
+    for o in invs:
+        by_key.setdefault(o["value"].key, []).append(o)
+    # each key gets exactly its 3 ops, all 10 keys eventually run
+    assert set(by_key) == set(range(10))
+    assert all(len(ops) == 3 for ops in by_key.values())
+    # each key is executed by exactly one group of n threads
+    for k, ops in by_key.items():
+        assert len({o["process"] % 4 for o in ops}) <= n_per_key
+    # two keys genuinely interleave at the start (two groups in parallel)
+    first8 = [o["value"].key for o in invs[:8]]
+    assert len(set(first8)) >= 2
+
+
+def test_concurrent_generator_concurrency_assertion():
+    g = independent.concurrent_generator(
+        8, [0], lambda k: gen.once({"f": "w"}))
+    test = {"concurrency": 4, "nodes": ["n1"]}
+    with pytest.raises(Exception, match="concurrency"):
+        gt.simulate(test, g, gt.perfect)
+
+
+def _keyed_history(keys, bad_keys=()):
+    """Valid (or corrupted) per-key cas-register histories interleaved."""
+    hist = []
+    for i, k in enumerate(keys):
+        p = i % 3
+        hist += [
+            inv(p, "write", T(k, 1)),
+            ok(p, "write", T(k, 1)),
+            inv(p, "read", T(k, None)),
+            ok(p, "read", T(k, 99 if k in bad_keys else 1)),
+        ]
+    return hist
+
+
+def test_independent_checker_splits_and_merges():
+    c = independent.checker(ck.linearizable({"model": "cas-register",
+                                             "algorithm": "wgl"}))
+    r = cc.check(c, {}, _keyed_history(["a", "b", "c"], bad_keys={"b"}))
+    assert r["valid"] is False
+    assert r["failures"] == ["b"]
+    assert r["results"]["a"]["valid"] is True
+    assert r["results"]["b"]["valid"] is False
+    assert r["results"]["c"]["valid"] is True
+
+
+def test_independent_checker_all_valid():
+    c = independent.checker(ck.linearizable({"model": "cas-register",
+                                             "algorithm": "wgl"}))
+    r = cc.check(c, {}, _keyed_history(list(range(4))))
+    assert r["valid"] is True
+    assert r["failures"] == []
+
+
+def test_independent_batched_single_device_call(monkeypatch):
+    """With a device-engine Linearizable inner checker, ALL keys go to
+    parallel.check_batch_encoded in ONE call (the TPU fast path)."""
+    from jepsen_tpu import parallel
+
+    calls = []
+    real = parallel.check_batch_encoded
+
+    def counting(spec, pairs, **kw):
+        calls.append(len(pairs))
+        return real(spec, pairs, **kw)
+
+    monkeypatch.setattr(parallel, "check_batch_encoded", counting)
+    c = independent.checker(ck.linearizable({"model": "cas-register",
+                                             "algorithm": "jax-wgl"}))
+    keys = list(range(6))
+    r = cc.check(c, {}, _keyed_history(keys, bad_keys={2, 4}))
+    assert calls == [6]        # one batched call for all six keys
+    assert r["valid"] is False
+    assert sorted(r["failures"]) == [2, 4]
+    for k in keys:
+        assert r["results"][k]["valid"] is (k not in (2, 4))
+
+
+def test_independent_batched_through_compose(monkeypatch):
+    """The register workload wraps Linearizable in a compose with
+    timeline; the fast path must still batch the linearizable member and
+    run the other members per key."""
+    from jepsen_tpu import parallel
+    from jepsen_tpu.checker import timeline
+
+    calls = []
+    real = parallel.check_batch_encoded
+
+    def counting(spec, pairs, **kw):
+        calls.append(len(pairs))
+        return real(spec, pairs, **kw)
+
+    monkeypatch.setattr(parallel, "check_batch_encoded", counting)
+    c = independent.checker(cc.compose({
+        "linearizable": ck.linearizable({"model": "cas-register",
+                                         "algorithm": "jax-wgl"}),
+        "timeline": timeline.html(),
+    }))
+    keys = ["a", "b", "c"]
+    r = cc.check(c, {}, _keyed_history(keys, bad_keys={"b"}))
+    assert calls == [3]
+    assert r["valid"] is False
+    assert r["failures"] == ["b"]
+    for k in keys:
+        kr = r["results"][k]
+        assert kr["linearizable"]["valid"] is (k != "b")
+        assert kr["timeline"]["valid"] is True
+        assert kr["valid"] is (k != "b")
+
+
+def test_independent_nonlinearizable_inner_uses_pmap():
+    """A non-Linearizable inner checker goes through the per-key path."""
+    seen = []
+
+    class Probe(cc.Checker):
+        def check(self, test, hist, opts=None):
+            seen.append(opts.get("history-key"))
+            return {"valid": True}
+
+    c = independent.checker(Probe())
+    r = cc.check(c, {}, _keyed_history(["x", "y"]))
+    assert r["valid"] is True
+    assert sorted(seen) == ["x", "y"]
+
+
+def test_independent_per_key_store_files(tmp_path, monkeypatch):
+    from jepsen_tpu import store
+    monkeypatch.setattr(store, "base_dir", str(tmp_path))
+    test = {"name": "indy", "start-time": "20260729T000000.000000+0000",
+            "nodes": []}
+    c = independent.checker(ck.linearizable({"model": "cas-register",
+                                             "algorithm": "wgl"}))
+    cc.check(c, test, _keyed_history(["a"]))
+    import os
+    d = store.path(test, independent.DIR, "a")
+    assert sorted(os.listdir(d)) == ["history.txt", "results.json"]

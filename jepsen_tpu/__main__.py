@@ -1,0 +1,53 @@
+"""Default CLI entry: the demo suite plus serve/analyze
+(reference cli.clj -main, extended with the demo workload registry).
+
+    python -m jepsen_tpu test --workload register --no-ssh
+    python -m jepsen_tpu test-all --no-ssh
+    python -m jepsen_tpu serve -p 8080
+"""
+
+from __future__ import annotations
+
+from . import cli, demo
+
+
+def _add_demo_opts(parser):
+    parser.add_argument("--workload", default="register",
+                        choices=sorted(demo.WORKLOADS),
+                        help="Which demo workload to run.")
+    parser.add_argument("--bug", default=None,
+                        choices=["lost-write", "dirty-read"],
+                        help="Inject a bug into the demo client so "
+                             "checkers catch it.")
+    parser.add_argument("--algorithm", default="jax-wgl",
+                        help="Linearizability engine (wgl, jax-wgl, "
+                             "competition).")
+    parser.add_argument("--per-key-limit", type=int, default=20,
+                        help="Ops per key for keyed workloads.")
+
+
+def _tests_fn(options):
+    tests = []
+    for name in sorted(demo.WORKLOADS):
+        opts = dict(options)
+        opts["workload"] = name
+        tests.append(demo.demo_test(opts))
+    return tests
+
+
+def main(argv=None):
+    subcommands = {}
+    subcommands.update(cli.single_test_cmd({
+        "test-fn": demo.demo_test,
+        "opt-spec": _add_demo_opts,
+    }))
+    subcommands.update(cli.test_all_cmd({
+        "tests-fn": _tests_fn,
+        "opt-spec": _add_demo_opts,
+    }))
+    subcommands.update(cli.serve_cmd())
+    cli.run(subcommands, argv)
+
+
+if __name__ == "__main__":
+    main()

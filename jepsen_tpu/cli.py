@@ -1,0 +1,299 @@
+"""Command line interface: a default main and utilities for suites to
+build their own test runners (reference jepsen/src/jepsen/cli.clj).
+
+Exit codes (cli.clj:129-139):
+
+  0     all tests passed
+  1     some test failed
+  2     some test had unknown validity
+  254   invalid arguments
+  255   internal error
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import re
+import sys
+import time
+import traceback
+
+from . import core, store
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+class CliError(Exception):
+    pass
+
+
+def add_test_opts(parser):
+    """The shared test option spec (cli.clj:64-111 test-opt-spec)."""
+    parser.add_argument("-n", "--node", action="append", default=None,
+                        metavar="HOSTNAME",
+                        help="Node(s) to run test on; repeatable.")
+    parser.add_argument("--nodes", default=None, metavar="NODE_LIST",
+                        help="Comma-separated list of node hostnames.")
+    parser.add_argument("--nodes-file", default=None, metavar="FILENAME",
+                        help="File of node hostnames, one per line.")
+    parser.add_argument("--username", default="root",
+                        help="Username for logins")
+    parser.add_argument("--password", default="root",
+                        help="Password for sudo access")
+    parser.add_argument("--strict-host-key-checking", action="store_true",
+                        help="Whether to check host keys")
+    parser.add_argument("--no-ssh", action="store_true",
+                        help="Don't establish SSH connections (dummy "
+                             "remote).")
+    parser.add_argument("--ssh-private-key", default=None, metavar="FILE",
+                        help="Path to an SSH identity file")
+    parser.add_argument("--concurrency", default="1n", metavar="NUMBER",
+                        help="Worker count: an integer, optionally followed"
+                             " by n (e.g. 3n) to multiply by node count.")
+    parser.add_argument("--leave-db-running", action="store_true",
+                        help="Leave the database running for inspection.")
+    parser.add_argument("--logging-json", action="store_true",
+                        help="JSON structured jepsen.log output.")
+    parser.add_argument("--test-count", type=int, default=1,
+                        help="How many times to repeat the test.")
+    parser.add_argument("--time-limit", type=int, default=60,
+                        metavar="SECONDS",
+                        help="How long the test runs, excluding setup and "
+                             "teardown.")
+    return parser
+
+
+def parse_concurrency(value, nodes):
+    """\"3n\" -> 3 * node count; plain integers parse as-is
+    (cli.clj:150-165)."""
+    m = re.fullmatch(r"(\d+)(n?)", str(value))
+    if not m:
+        raise CliError(f"--concurrency {value} should be an integer "
+                       "optionally followed by n")
+    unit = len(nodes) if m.group(2) == "n" else 1
+    return int(m.group(1)) * unit
+
+
+def parse_nodes(opts):
+    """Merge --node/--nodes/--nodes-file into one list
+    (cli.clj:167-202)."""
+    out = []
+    if opts.get("nodes-file"):
+        with open(opts["nodes-file"]) as f:
+            out += [ln.strip() for ln in f if ln.strip()]
+    if opts.get("nodes"):
+        out += [n.strip() for n in str(opts["nodes"]).split(",")]
+    if opts.get("node"):
+        out += list(opts["node"])
+    return out or list(DEFAULT_NODES)
+
+
+def test_opt_fn(opts):
+    """Standard option pipeline: merge node specs, build the :ssh map,
+    parse concurrency (cli.clj:242-253 test-opt-fn)."""
+    opts = dict(opts)
+    nodes = parse_nodes(opts)
+    opts["nodes"] = nodes
+    opts["concurrency"] = parse_concurrency(
+        opts.get("concurrency", "1n"), nodes)
+    opts["ssh"] = {
+        "dummy?": bool(opts.pop("no-ssh", False)),
+        "username": opts.pop("username", "root"),
+        "password": opts.pop("password", "root"),
+        "strict-host-key-checking":
+            opts.pop("strict-host-key-checking", False),
+        "private-key-path": opts.pop("ssh-private-key", None),
+    }
+    opts["leave-db-running?"] = opts.pop("leave-db-running", False)
+    opts["logging-json?"] = opts.pop("logging-json", False)
+    opts.pop("node", None)
+    opts.pop("nodes-file", None)
+    return opts
+
+
+def _ns_to_opts(ns):
+    return {k.replace("_", "-"): v for k, v in vars(ns).items()}
+
+
+def _exit_for_valid(valid):
+    if valid is False:
+        return 1
+    if valid != True:  # noqa: E712 - "unknown" and None both count
+        return 2
+    return 0
+
+
+def single_test_cmd(opts):
+    """Subcommands ``test`` (run + analyze) and ``analyze`` (re-check the
+    latest stored history with a freshly-built test map)
+    (cli.clj:352-427). opts: {"test-fn": options -> test map,
+    "opt-spec": fn(parser), "opt-fn": fn(options)}."""
+    test_fn = opts["test-fn"]
+
+    def run_test(options):
+        for _i in range(options.get("test-count", 1)):
+            test = core.run(test_fn(options))
+            code = _exit_for_valid(
+                (test.get("results") or {}).get("valid"))
+            if code:
+                sys.exit(code)
+
+    def run_analyze(options):
+        cli_test = test_fn(options)
+        stored = store.latest()
+        if stored is None:
+            raise CliError("Not sure what the last test was")
+        if stored.get("name") != cli_test.get("name"):
+            raise CliError(
+                f"Stored test ({stored.get('name')}) and CLI test "
+                f"({cli_test.get('name')}) have different names; aborting")
+        test = {**cli_test,
+                **{k: v for k, v in stored.items() if k != "results"}}
+        test = core.analyze(test)
+        sys.exit(_exit_for_valid(
+            (test.get("results") or {}).get("valid")))
+
+    return {
+        "test": {"opt-spec": opts.get("opt-spec"),
+                 "opt-fn": opts.get("opt-fn"),
+                 "run": run_test,
+                 "help": "Run a test and analyze it."},
+        "analyze": {"opt-spec": opts.get("opt-spec"),
+                    "opt-fn": opts.get("opt-fn"),
+                    "run": run_analyze,
+                    "help": "Re-analyze the latest stored history."},
+    }
+
+
+def test_all_run_tests(tests):
+    """Run tests; map of outcome (True/False/'unknown'/'crashed') to store
+    paths (cli.clj:429-445)."""
+    results = {}
+    for test in tests:
+        test = core.prepare_test(test)
+        try:
+            done = core.run(test)
+            outcome = (done.get("results") or {}).get("valid")
+            if outcome is not True and outcome is not False:
+                outcome = "unknown"
+        except Exception:  # noqa: BLE001
+            logger.warning("Test crashed\n%s", traceback.format_exc())
+            outcome = "crashed"
+        try:
+            path = store.path(test)
+        except AssertionError:
+            path = "<unnamed>"
+        results.setdefault(outcome, []).append(path)
+    return results
+
+
+def test_all_print_summary(results):
+    """Print outcome groups + counts (cli.clj:447-476)."""
+    for title, key in (("Successful tests", True),
+                       ("Indeterminate tests", "unknown"),
+                       ("Crashed tests", "crashed"),
+                       ("Failed tests", False)):
+        if results.get(key):
+            print(f"\n# {title}\n")
+            for p in results[key]:
+                print(p)
+    print()
+    print(len(results.get(True, [])), "successes")
+    print(len(results.get("unknown", [])), "unknown")
+    print(len(results.get("crashed", [])), "crashed")
+    print(len(results.get(False, [])), "failures")
+    return results
+
+
+def test_all_exit_code(results):
+    """255 crashed > 2 unknown > 1 failed > 0 (cli.clj:478-485)."""
+    if results.get("crashed"):
+        return 255
+    if results.get("unknown"):
+        return 2
+    if results.get(False):
+        return 1
+    return 0
+
+
+def test_all_cmd(opts):
+    """Subcommand ``test-all``: run a suite of tests
+    (cli.clj:487-515). opts: {"tests-fn": options -> [test maps], ...}."""
+    tests_fn = opts["tests-fn"]
+
+    def run_all(options):
+        results = test_all_run_tests(tests_fn(options))
+        test_all_print_summary(results)
+        sys.exit(test_all_exit_code(results))
+
+    return {"test-all": {"opt-spec": opts.get("opt-spec"),
+                         "opt-fn": opts.get("opt-fn"),
+                         "run": run_all,
+                         "help": "Run a whole suite of tests."}}
+
+
+def serve_cmd():
+    """Subcommand ``serve``: the web interface (cli.clj:333-350)."""
+
+    def add_opts(parser):
+        parser.add_argument("-b", "--host", default="0.0.0.0",
+                            help="Hostname to bind to")
+        parser.add_argument("-p", "--port", type=int, default=8080,
+                            help="Port number to bind to")
+
+    def run_serve(options):
+        from . import web
+        web.serve({"ip": options.get("host", "0.0.0.0"),
+                   "port": options.get("port", 8080)})
+        print(f"Listening on http://{options.get('host')}:"
+              f"{options.get('port')}/")
+        while True:
+            time.sleep(1)
+
+    return {"serve": {"opt-spec": add_opts, "opt-fn": lambda o: o,
+                      "standalone": True, "run": run_serve,
+                      "help": "Serve the web interface."}}
+
+
+def run(subcommands, argv=None):
+    """Parse arguments and dispatch to a subcommand (cli.clj:255-331).
+    Exits 254 on bad usage, 255 on internal errors; subcommand run fns
+    control success exit codes."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s\t%(levelname)s\t%(name)s: %(message)s")
+    command = argv[0] if argv else None
+    if command not in subcommands:
+        print("Usage: python -m jepsen_tpu COMMAND [OPTIONS ...]")
+        print("Commands:", ", ".join(sorted(subcommands)))
+        sys.exit(254)
+    spec = subcommands[command]
+    parser = argparse.ArgumentParser(prog=f"jepsen_tpu {command}")
+    if not spec.get("standalone"):
+        add_test_opts(parser)
+    if spec.get("opt-spec"):
+        spec["opt-spec"](parser)
+    try:
+        ns = parser.parse_args(argv[1:])
+    except SystemExit as e:
+        sys.exit(0 if e.code in (0, None) else 254)
+    try:
+        options = _ns_to_opts(ns)
+        options["argv"] = argv
+        opt_fn = spec.get("opt-fn") or test_opt_fn
+        options = opt_fn(options)
+        spec["run"](options)
+        sys.exit(0)
+    except SystemExit:
+        raise
+    except CliError as e:
+        print(str(e), file=sys.stderr)
+        sys.exit(254)
+    except Exception:  # noqa: BLE001
+        logger.critical("Oh jeez, I'm sorry, Jepsen broke. Here's why:\n%s",
+                        traceback.format_exc())
+        sys.exit(255)

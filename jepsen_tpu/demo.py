@@ -1,0 +1,240 @@
+"""A built-in demo "database": in-memory clients for every stock workload,
+runnable with the dummy remote — the out-of-the-box consumer suite.
+
+    python -m jepsen_tpu test --workload register --no-ssh
+    python -m jepsen_tpu test --workload bank --no-ssh --bug lost-write
+
+This plays the role of the reference's per-database suites (SURVEY.md
+section 2.8): a workload registry plus clients, wired into the standard
+CLI (cli.clj:352-427). ``--bug`` injects misbehavior so checkers have
+something to catch (exit code 1).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import checker as cc
+from . import client as jclient
+from . import db as jdb
+from . import generator as gen
+from . import independent
+from .checker import checkers as cks
+from .tests import bank as bank_workload
+from .tests import linearizable_register
+
+
+class DemoState:
+    """Shared in-memory cluster state."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.registers = {}
+        self.balances = {}
+        self.set = set()
+
+
+class DemoDB(jdb.DB):
+    def __init__(self, state):
+        self.state = state
+
+    def setup(self, test, node):
+        with self.state.lock:
+            self.state.registers.clear()
+            self.state.set.clear()
+            accounts = test.get("accounts") or []
+            total = test.get("total-amount") or 0
+            if accounts:
+                per = total // len(accounts)
+                self.state.balances = {a: per for a in accounts}
+                self.state.balances[accounts[0]] += total - per * len(
+                    accounts)
+
+    def teardown(self, test, node):
+        pass
+
+
+class RegisterClient(jclient.Client):
+    """Keyed cas-register client; --bug lost-write drops every 5th write,
+    --bug dirty-read returns garbage occasionally."""
+
+    def __init__(self, state, bug=None):
+        self.state = state
+        self.bug = bug
+        self._n = 0
+
+    def open(self, test, node):
+        return RegisterClient(self.state, self.bug)
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        out = dict(op)
+        with self.state.lock:
+            self._n += 1
+            if op["f"] == "write":
+                if self.bug == "lost-write" and self._n % 5 == 0:
+                    out["type"] = "ok"   # acked but not applied
+                else:
+                    self.state.registers[k] = v
+                    out["type"] = "ok"
+            elif op["f"] == "read":
+                val = self.state.registers.get(k)
+                if self.bug == "dirty-read" and self._n % 7 == 0:
+                    val = 99
+                out["type"] = "ok"
+                out["value"] = independent.tuple_(k, val)
+            elif op["f"] == "cas":
+                cur, new = v
+                if self.state.registers.get(k) == cur:
+                    self.state.registers[k] = new
+                    out["type"] = "ok"
+                else:
+                    out["type"] = "fail"
+        return out
+
+
+class BankClient(jclient.Client):
+    def __init__(self, state, bug=None):
+        self.state = state
+        self.bug = bug
+        self._n = 0
+
+    def open(self, test, node):
+        return BankClient(self.state, self.bug)
+
+    def invoke(self, test, op):
+        out = dict(op)
+        with self.state.lock:
+            self._n += 1
+            if op["f"] == "read":
+                out["type"] = "ok"
+                out["value"] = dict(self.state.balances)
+            else:
+                v = op["value"]
+                if self.state.balances.get(v["from"], 0) < v["amount"]:
+                    out["type"] = "fail"
+                else:
+                    self.state.balances[v["from"]] -= v["amount"]
+                    self.state.balances[v["to"]] += v["amount"]
+                    if self.bug == "lost-write" and self._n % 5 == 0:
+                        # partial apply: money vanishes
+                        self.state.balances[v["to"]] -= 1
+                    out["type"] = "ok"
+        return out
+
+
+class SetClient(jclient.Client):
+    def __init__(self, state, bug=None):
+        self.state = state
+        self.bug = bug
+        self._n = 0
+
+    def open(self, test, node):
+        return SetClient(self.state, self.bug)
+
+    def invoke(self, test, op):
+        out = dict(op)
+        with self.state.lock:
+            self._n += 1
+            if op["f"] == "add":
+                if not (self.bug == "lost-write" and self._n % 5 == 0):
+                    self.state.set.add(op["value"])
+                out["type"] = "ok"
+            elif op["f"] == "read":
+                out["type"] = "ok"
+                out["value"] = sorted(self.state.set)
+        return out
+
+
+def register_workload(opts, state):
+    w = linearizable_register.test({
+        "nodes": opts["nodes"],
+        "algorithm": opts.get("algorithm", "jax-wgl"),
+        "per-key-limit": opts.get("per-key-limit", 20),
+    })
+    return {**w, "client": RegisterClient(state, opts.get("bug"))}
+
+
+def bank_workload_fn(opts, state):
+    w = bank_workload.test()
+    return {**w,
+            "client": BankClient(state, opts.get("bug")),
+            "generator": gen.clients(w["generator"])}
+
+
+def set_workload(opts, state):
+    counter = {"n": 0}
+
+    def add(test, ctx):
+        counter["n"] += 1
+        return {"type": "invoke", "f": "add", "value": counter["n"]}
+
+    g = gen.phases(
+        gen.clients(gen.limit(
+            opts.get("ops", 500), gen.stagger(0.001, add))),
+        gen.clients(gen.once({"type": "invoke", "f": "read"})))
+    return {"client": SetClient(state, opts.get("bug")),
+            "checker": cks.set_checker(),
+            "generator": g}
+
+
+def noop_workload(opts, state):
+    return {"client": jclient.noop,
+            "checker": cc.unbridled_optimism(),
+            "generator": gen.clients(gen.limit(
+                10, gen.repeat({"f": "read"})))}
+
+
+WORKLOADS = {
+    "register": register_workload,
+    "bank": bank_workload_fn,
+    "set": set_workload,
+    "noop": noop_workload,
+}
+
+
+def demo_test(options):
+    """Build a full test map from parsed CLI options (the suite's
+    test-fn)."""
+    from . import nemesis as jnemesis
+    from .os import noop as os_noop
+
+    state = DemoState()
+    name = options.get("workload", "register")
+    concurrency = options.get("concurrency") or len(options["nodes"])
+    if name == "register":
+        # the register workload groups 2*len(nodes) threads per key and
+        # needs the worker count to be a multiple of the group size
+        # (independent.clj:49-77)
+        group = 2 * len(options["nodes"])
+        concurrency = max(group,
+                          (concurrency + group - 1) // group * group)
+    options = {**options, "concurrency": concurrency}
+    workload = WORKLOADS[name](options, state)
+    generator = gen.time_limit(options.get("time-limit", 60),
+                               workload["generator"])
+    checker = cc.compose({
+        "workload": workload["checker"],
+        "stats": cks.stats(),
+        "exceptions": cks.unhandled_exceptions(),
+    })
+    test = {
+        "name": f"demo-{name}" + (f"-{options['bug']}"
+                                  if options.get("bug") else ""),
+        "nodes": options["nodes"],
+        "concurrency": concurrency,
+        "ssh": options.get("ssh", {"dummy?": True}),
+        "os": os_noop,
+        "db": DemoDB(state),
+        "nemesis": jnemesis.noop,
+        "client": workload["client"],
+        "generator": generator,
+        "checker": checker,
+        "leave-db-running?": options.get("leave-db-running?", False),
+        "logging-json?": options.get("logging-json?", False),
+    }
+    if name == "bank":
+        base = bank_workload.test()
+        test.update({k: base[k] for k in ("accounts", "total-amount",
+                                          "max-transfer")})
+    return test

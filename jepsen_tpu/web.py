@@ -1,0 +1,168 @@
+"""Web interface: a minimal HTTP server for browsing the store directory
+(reference jepsen/src/jepsen/web.clj).
+
+Home page lists tests with validity-colored rows (web.clj:104-134); test
+directories are browsable with file streaming and whole-dir zip download
+(web.clj:262-303), with a path-traversal guard (web.clj:304-309).
+"""
+
+from __future__ import annotations
+
+import html
+import io
+import json
+import logging
+import os
+import threading
+import urllib.parse
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import store
+
+logger = logging.getLogger(__name__)
+
+STYLE = """
+body { font-family: sans-serif; margin: 2em; }
+table { border-collapse: collapse; }
+td, th { padding: 4px 12px; text-align: left; }
+tr.valid-true { background: #ADF6B0; }
+tr.valid-false { background: #F6B5AD; }
+tr.valid-unknown { background: #F3F6AD; }
+a { text-decoration: none; }
+"""
+
+
+def _valid_class(valid):
+    if valid is True:
+        return "valid-true"
+    if valid is False:
+        return "valid-false"
+    return "valid-unknown"
+
+
+def _fast_tests():
+    """Test rows from results.json headers only (web.clj:48-69)."""
+    rows = []
+    for name in store.test_names():
+        for t in sorted(store.tests(name), reverse=True):
+            valid = None
+            try:
+                r = store.load_results(name, t)
+                valid = r.get("valid") if isinstance(r, dict) else None
+            except (FileNotFoundError, json.JSONDecodeError):
+                valid = "incomplete"
+            rows.append({"name": name, "time": t, "valid": valid})
+    rows.sort(key=lambda r: r["time"], reverse=True)
+    return rows
+
+
+def _home_page():
+    rows = []
+    for t in _fast_tests():
+        link = f"/files/{urllib.parse.quote(t['name'])}/" \
+               f"{urllib.parse.quote(t['time'])}/"
+        zip_link = link.rstrip("/") + ".zip"
+        rows.append(
+            f'<tr class="{_valid_class(t["valid"])}">'
+            f'<td>{html.escape(t["name"])}</td>'
+            f'<td><a href="{link}">{html.escape(t["time"])}</a></td>'
+            f'<td>{html.escape(str(t["valid"]))}</td>'
+            f'<td><a href="{zip_link}">zip</a></td></tr>')
+    return f"""<html><head><style>{STYLE}</style>
+<title>Jepsen</title></head><body>
+<h1>Jepsen</h1>
+<table><thead><tr><th>Test</th><th>Time</th><th>Valid?</th><th></th>
+</tr></thead><tbody>{''.join(rows)}</tbody></table></body></html>"""
+
+
+def _dir_page(rel, full):
+    entries = sorted(os.listdir(full))
+    items = []
+    for e in entries:
+        p = os.path.join(full, e)
+        slash = "/" if os.path.isdir(p) else ""
+        items.append(f'<li><a href="{urllib.parse.quote(e)}{slash}">'
+                     f"{html.escape(e)}{slash}</a></li>")
+    return f"""<html><head><style>{STYLE}</style></head><body>
+<h1>/{html.escape(rel)}</h1><ul>{''.join(items)}</ul></body></html>"""
+
+
+def _zip_dir(full):
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, _dirs, files in os.walk(full):
+            for f in files:
+                p = os.path.join(root, f)
+                z.write(p, os.path.relpath(p, os.path.dirname(full)))
+    return buf.getvalue()
+
+
+class Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # quiet
+        logger.debug("web: " + fmt, *args)
+
+    def _send(self, code, body, ctype="text/html; charset=utf-8"):
+        if isinstance(body, str):
+            body = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        try:
+            path = urllib.parse.unquote(
+                urllib.parse.urlparse(self.path).path)
+            if path in ("", "/"):
+                return self._send(200, _home_page())
+            if path.startswith("/files/"):
+                return self._files(path[len("/files/"):])
+            return self._send(404, "<h1>404</h1>")
+        except BrokenPipeError:
+            pass
+        except Exception:  # noqa: BLE001
+            logger.warning("web handler error", exc_info=True)
+            try:
+                self._send(500, "<h1>500</h1>")
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _files(self, rel):
+        want_zip = rel.endswith(".zip")
+        if want_zip:
+            rel = rel[:-len(".zip")]
+        base = os.path.realpath(store.base_dir)
+        full = os.path.realpath(os.path.join(base, rel.strip("/")))
+        # path-traversal guard (web.clj:304-309)
+        if not (full == base or full.startswith(base + os.sep)):
+            return self._send(403, "<h1>403</h1>")
+        if not os.path.exists(full):
+            return self._send(404, "<h1>404</h1>")
+        if want_zip and os.path.isdir(full):
+            return self._send(200, _zip_dir(full), "application/zip")
+        if os.path.isdir(full):
+            return self._send(200, _dir_page(rel.strip("/"), full))
+        ctype = "text/plain; charset=utf-8"
+        if full.endswith(".html"):
+            ctype = "text/html; charset=utf-8"
+        elif full.endswith(".png"):
+            ctype = "image/png"
+        elif full.endswith(".json") or full.endswith(".jsonl"):
+            ctype = "application/json"
+        with open(full, "rb") as f:
+            return self._send(200, f.read(), ctype)
+
+
+def serve(opts=None):
+    """Starts the server; returns it (web.clj:361-366). Options: ip
+    (default 0.0.0.0), port (default 8080)."""
+    opts = opts or {}
+    addr = (opts.get("ip", "0.0.0.0"), opts.get("port", 8080))
+    server = ThreadingHTTPServer(addr, Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="jepsen web")
+    thread.start()
+    logger.info("Web server on http://%s:%d/", *addr)
+    return server

@@ -1,0 +1,131 @@
+"""CLI, demo suite, and web tests (reference cli.clj semantics: option
+parsing, "3n" concurrency, exit codes 0/1/2/254/255; web.clj browsing)."""
+
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import cli, store
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_concurrency():
+    assert cli.parse_concurrency("10", ["a", "b"]) == 10
+    assert cli.parse_concurrency("3n", ["a", "b"]) == 6
+    assert cli.parse_concurrency("1n", ["a"] * 5) == 5
+    with pytest.raises(cli.CliError):
+        cli.parse_concurrency("n3", ["a"])
+    with pytest.raises(cli.CliError):
+        cli.parse_concurrency("3x", ["a"])
+
+
+def test_parse_nodes(tmp_path):
+    assert cli.parse_nodes({}) == cli.DEFAULT_NODES
+    assert cli.parse_nodes({"node": ["a", "b"]}) == ["a", "b"]
+    assert cli.parse_nodes({"nodes": "x, y,z"}) == ["x", "y", "z"]
+    f = tmp_path / "nodes.txt"
+    f.write_text("h1\nh2\n\n")
+    assert cli.parse_nodes({"nodes-file": str(f)}) == ["h1", "h2"]
+    assert cli.parse_nodes({"nodes-file": str(f), "node": ["a"]}) == \
+        ["h1", "h2", "a"]
+
+
+def test_test_opt_fn():
+    opts = cli.test_opt_fn({
+        "node": None, "nodes": None, "nodes-file": None,
+        "username": "admin", "password": "pw", "no-ssh": True,
+        "strict-host-key-checking": False, "ssh-private-key": None,
+        "concurrency": "2n", "leave-db-running": True,
+        "logging-json": False, "test-count": 1, "time-limit": 60,
+    })
+    assert opts["nodes"] == cli.DEFAULT_NODES
+    assert opts["concurrency"] == 10
+    assert opts["ssh"]["dummy?"] is True
+    assert opts["ssh"]["username"] == "admin"
+    assert opts["leave-db-running?"] is True
+    assert "no-ssh" not in opts
+
+
+def test_exit_code_mapping():
+    assert cli._exit_for_valid(True) == 0
+    assert cli._exit_for_valid(False) == 1
+    assert cli._exit_for_valid("unknown") == 2
+    assert cli._exit_for_valid(None) == 2
+    assert cli.test_all_exit_code({True: ["a"]}) == 0
+    assert cli.test_all_exit_code({True: ["a"], False: ["b"]}) == 1
+    assert cli.test_all_exit_code({"unknown": ["a"]}) == 2
+    assert cli.test_all_exit_code({"crashed": ["a"], False: ["b"]}) == 255
+
+
+def _run_cli(args, cwd):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu"] + args,
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=300)
+
+
+def test_cli_demo_valid_exit_0(tmp_path):
+    r = _run_cli(["test", "--workload", "noop", "--no-ssh",
+                  "--time-limit", "1"], str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert (tmp_path / "store" / "demo-noop").is_dir()
+    assert (tmp_path / "store" / "latest").is_symlink()
+
+
+def test_cli_demo_bug_exit_1(tmp_path):
+    r = _run_cli(["test", "--workload", "register", "--no-ssh",
+                  "--time-limit", "2", "--bug", "dirty-read",
+                  "--algorithm", "wgl", "--per-key-limit", "8"],
+                 str(tmp_path))
+    assert r.returncode == 1, r.stderr[-2000:]
+    d = tmp_path / "store" / "demo-register-dirty-read"
+    assert d.is_dir()
+    runs = [p for p in d.iterdir() if p.is_dir()]
+    assert runs
+    files = {f.name for f in runs[0].iterdir()}
+    assert {"history.txt", "history.jsonl", "results.json",
+            "test.json", "jepsen.log"} <= files
+
+
+def test_cli_unknown_command(tmp_path):
+    r = _run_cli(["frobnicate"], str(tmp_path))
+    assert r.returncode == 254
+
+
+def test_web_serve(tmp_path, monkeypatch):
+    """Home page with validity-colored rows, browsing, zip download, and
+    the path-traversal guard (web.clj:104-309)."""
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+    ts = "20260729T000000.000000+0000"
+    good = {"name": "webtest", "start-time": ts,
+            "history": [], "results": {"valid": True}}
+    store.save_2(good)
+    from jepsen_tpu import web
+    srv = web.serve({"ip": "127.0.0.1", "port": 0})
+    try:
+        port = srv.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        home = urllib.request.urlopen(base + "/").read().decode()
+        assert "webtest" in home
+        assert "valid-true" in home
+        listing = urllib.request.urlopen(
+            f"{base}/files/webtest/{ts}/").read().decode()
+        assert "results.json" in listing
+        data = urllib.request.urlopen(
+            f"{base}/files/webtest/{ts}/results.json").read()
+        assert b"valid" in data
+        z = urllib.request.urlopen(
+            f"{base}/files/webtest/{ts}.zip").read()
+        assert z[:2] == b"PK"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/files/../../etc/passwd")
+        assert ei.value.code in (403, 404)
+    finally:
+        srv.shutdown()

@@ -125,7 +125,10 @@ def _f_matches(f, fs):
 def nemesis_ops(nemeses, history):
     """Partition nemesis ops in history among the nemesis specs
     (perf.clj:184-216); unmatched ops fall to a default "nemesis" spec."""
-    nemeses = list(nemeses or [])
+    # nemesis packages store perf specs as frozen item tuples so they can
+    # live in sets (nemesis/combined._perf); accept those alongside dicts
+    nemeses = [dict(s) if isinstance(s, tuple) else s
+               for s in (nemeses or [])]
     index = {}
     for spec in nemeses:
         for f in (list(spec.get("start", ["start"]))

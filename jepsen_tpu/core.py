@@ -11,6 +11,7 @@ around the interpreter) -> save-1 -> analyze (save-2) -> log-results.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import logging
 import threading
 import traceback
@@ -189,7 +190,9 @@ def with_client_nemesis_setup_teardown(test):
         except Exception as e:  # noqa: BLE001
             nemesis_box["error"] = e
 
-    nf = threading.Thread(target=setup_nemesis, name="jepsen nemesis setup")
+    nf = threading.Thread(target=contextvars.copy_context().run,
+                          args=(setup_nemesis,),
+                          name="jepsen nemesis setup")
     nf.start()
 
     def open_one(node):
@@ -215,7 +218,8 @@ def with_client_nemesis_setup_teardown(test):
         def teardown_nemesis():
             test["nemesis"].teardown(test)
 
-        nt = threading.Thread(target=teardown_nemesis,
+        nt = threading.Thread(target=contextvars.copy_context().run,
+                              args=(teardown_nemesis,),
                               name="jepsen nemesis teardown")
         nt.start()
 

@@ -234,7 +234,7 @@ def demo_test(options):
         "logging-json?": options.get("logging-json?", False),
     }
     if name == "bank":
-        base = bank_workload.test()
-        test.update({k: base[k] for k in ("accounts", "total-amount",
-                                          "max-transfer")})
+        # the workload bundle already carries the generator's constants
+        test.update({k: workload[k] for k in ("accounts", "total-amount",
+                                              "max-transfer")})
     return test

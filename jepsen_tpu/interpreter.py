@@ -11,6 +11,7 @@ next invocation, dispatching when its scheduled time arrives
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import queue
 import threading
@@ -133,7 +134,11 @@ def _spawn_worker(test, completions, worker, wid):
         finally:
             w.close(test)
 
-    thread = threading.Thread(target=loop, daemon=True,
+    # run the worker in a snapshot of the spawning thread's context so
+    # control-plane session bindings (c.ssh_scope) reach client/nemesis
+    # invocations on this thread
+    ctx = contextvars.copy_context()
+    thread = threading.Thread(target=ctx.run, args=(loop,), daemon=True,
                               name=f"jepsen worker {wid}")
     thread.start()
     return {"id": wid, "inbox": inbox, "thread": thread}

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import contextlib
+import contextvars
 import random
 import threading
 import time as _time
@@ -84,8 +85,11 @@ def real_pmap(f, coll):
     coll = list(coll)
     if not coll:
         return []
+    # propagate the caller's contextvars (control-plane session bindings)
+    # into the pool threads
+    ctx = contextvars.copy_context()
     with concurrent.futures.ThreadPoolExecutor(max_workers=len(coll)) as ex:
-        futures = [ex.submit(f, x) for x in coll]
+        futures = [ex.submit(ctx.copy().run, f, x) for x in coll]
         results = []
         errs = []
         for fut in futures:
@@ -108,8 +112,9 @@ def bounded_pmap(f, coll, bound=None):
     if not coll:
         return []
     bound = bound or min(32, len(coll))
+    ctx = contextvars.copy_context()
     with concurrent.futures.ThreadPoolExecutor(max_workers=bound) as ex:
-        return list(ex.map(f, coll))
+        return list(ex.map(lambda x: ctx.copy().run(f, x), coll))
 
 
 class Timeout(Exception):
@@ -199,3 +204,16 @@ def print_history(history, out=None):
     out = out or sys.stdout
     for o in history:
         out.write(op_str(o) + "\n")
+
+
+def random_nonempty_subset(coll, rng=random):
+    """A randomly sized non-empty random subset of coll, order preserved;
+    empty when coll is empty (util.clj random-nonempty-subset) — e.g. a
+    "primaries" target during an election targets nobody rather than
+    crashing the nemesis."""
+    coll = list(coll)
+    if not coll:
+        return []
+    n = rng.randint(1, len(coll))
+    picked = set(rng.sample(range(len(coll)), n))
+    return [x for i, x in enumerate(coll) if i in picked]

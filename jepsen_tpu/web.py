@@ -143,6 +143,13 @@ class Handler(BaseHTTPRequestHandler):
         if want_zip and os.path.isdir(full):
             return self._send(200, _zip_dir(full), "application/zip")
         if os.path.isdir(full):
+            if rel and not rel.endswith("/"):
+                # dir pages use relative links; force the trailing slash
+                # so they resolve against this directory
+                self.send_response(301)
+                self.send_header("Location", f"/files/{rel}/")
+                self.end_headers()
+                return None
             return self._send(200, _dir_page(rel.strip("/"), full))
         ctype = "text/plain; charset=utf-8"
         if full.endswith(".html"):

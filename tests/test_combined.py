@@ -1,0 +1,185 @@
+"""Combined nemesis package tests (reference nemesis/combined.clj):
+node/partition spec resolution, package algebra, and an end-to-end
+core.run whose dummy remote records the expected command stream."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu import core
+from jepsen_tpu import db as jdb
+from jepsen_tpu import generator as gen
+from jepsen_tpu import store
+from jepsen_tpu import tests as tst
+from jepsen_tpu.nemesis import combined as nc
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+class ProcDB(jdb.DB, jdb.Process, jdb.Pause):
+    """A DB whose process controls shell out, so the dummy log records
+    them."""
+
+    def setup(self, test, node):
+        pass
+
+    def teardown(self, test, node):
+        pass
+
+    def start(self, test, node):
+        c.exec_("db-start")
+        return "started"
+
+    def kill(self, test, node):
+        with c.su():
+            c.exec_("pkill", "-9", "-f", "db")
+        return "killed"
+
+    def pause(self, test, node):
+        c.exec_("pkill", "-STOP", "-f", "db")
+        return "paused"
+
+    def resume(self, test, node):
+        c.exec_("pkill", "-CONT", "-f", "db")
+        return "resumed"
+
+
+class PrimaryDB(ProcDB, jdb.Primary):
+    def primaries(self, test):
+        return test["nodes"][:2]
+
+    def setup_primary(self, test, node):
+        pass
+
+
+def test_db_nodes_specs():
+    random.seed(45100)
+    test = {"nodes": NODES}
+    db = PrimaryDB()
+    assert len(nc.db_nodes(test, db, "one")) == 1
+    assert len(nc.db_nodes(test, db, "minority")) == 2
+    assert len(nc.db_nodes(test, db, "majority")) == 3
+    assert len(nc.db_nodes(test, db, "minority-third")) == 1
+    assert nc.db_nodes(test, db, "all") == NODES
+    assert set(nc.db_nodes(test, db, "primaries")) <= {"n1", "n2"}
+    assert 1 <= len(nc.db_nodes(test, db, None)) <= 5
+    assert nc.db_nodes(test, db, ["n4"]) == ["n4"]
+
+
+def test_node_and_partition_specs_reflect_db():
+    assert "primaries" not in nc.node_specs(ProcDB())
+    assert "primaries" in nc.node_specs(PrimaryDB())
+    assert "primaries" not in nc.partition_specs(ProcDB())
+    assert "primaries" in nc.partition_specs(PrimaryDB())
+
+
+def test_grudge_specs():
+    random.seed(45100)
+    test = {"nodes": NODES}
+    db = PrimaryDB()
+    g1 = nc.grudge(test, db, "one")
+    isolated = [n for n in NODES if len(g1.get(n, ())) == 4]
+    assert len(isolated) == 1
+    gm = nc.grudge(test, db, "majority")
+    sizes = sorted(len(v) for v in gm.values())
+    assert sizes == [2, 2, 2, 3, 3]   # 2-node side grudges 3, and vice versa
+    gr = nc.grudge(test, db, "majorities-ring")
+    for n in NODES:
+        assert len(NODES) - len(gr[n]) >= 3   # every node still sees a majority
+    gp = nc.grudge(test, db, "primaries")
+    assert any(len(v) >= 3 for v in gp.values())
+    explicit = {"n1": {"n2"}}
+    assert nc.grudge(test, db, explicit) is explicit
+
+
+def test_package_structure_and_fs():
+    pkg = nc.nemesis_package({"db": PrimaryDB(), "interval": 1})
+    fs = pkg["nemesis"].fs()
+    assert {"start", "kill", "pause", "resume",
+            "start-partition", "stop-partition",
+            "reset-clock", "bump-clock", "strobe-clock",
+            "check-clock-offsets"} <= fs
+    assert pkg["generator"] is not None
+    assert isinstance(pkg["final_generator"], list)
+    names = {nc.perf_spec(p)["name"] for p in pkg["perf"]}
+    assert names == {"kill", "pause", "partition", "clock"}
+
+
+def test_faults_select_packages():
+    pkg = nc.nemesis_package({"db": ProcDB(), "faults": ["kill"]})
+    assert pkg["generator"] is not None
+    # partition and clock packages contribute no generator
+    pkg2 = nc.nemesis_package({"db": ProcDB(), "faults": []})
+    assert pkg2["generator"] is None
+
+
+def test_f_map_lifts_package():
+    pkg = nc.partition_package({"db": ProcDB(),
+                                "faults": {"partition"}, "interval": 1})
+    lifted = nc.f_map(lambda f: f"db1-{f}", pkg)
+    assert lifted["nemesis"].fs() == {"db1-start-partition",
+                                      "db1-stop-partition"}
+    spec = nc.perf_spec(next(iter(lifted["perf"])))
+    assert spec["start"] == {"db1-start-partition"}
+    assert spec["name"] == "db1-partition"
+
+
+def test_kill_package_end_to_end_command_stream():
+    """A kill package composed into a generator phase drives real commands
+    through core.run's dummy remote (flip-flop: kill then start)."""
+    random.seed(45100)
+    test = tst.noop_test()
+    test["ssh"] = {"dummy?": True}
+    test["db"] = ProcDB()
+    pkg = nc.nemesis_package(
+        {"db": test["db"], "faults": ["kill"], "interval": 0.01,
+         "kill": {"targets": ["all"]}})
+    test["nemesis"] = pkg["nemesis"]
+    test["generator"] = gen.nemesis(
+        [gen.limit(2, pkg["generator"]), pkg["final_generator"]])
+    done = core.run(test)
+    hist = done["history"]
+    nem_ops = [o for o in hist if o["process"] == "nemesis"
+               and o["type"] == "info" and o.get("value") is not None]
+    fseq = [o["f"] for o in nem_ops if "clock_offsets" not in o]
+    # flip-flop emits kill, start; the final generator appends one more start
+    assert fseq[:2] == ["kill", "kill"] or fseq[0] == "kill"
+    assert "start" in fseq
+    cmds = [cmd for _, cmd in done["dummy-log"]]
+    kills = [x for x in cmds if "pkill -9 -f db" in x]
+    starts = [x for x in cmds if "db-start" in x]
+    assert len(kills) == 5       # kill targeted :all on 5 nodes
+    assert len(starts) >= 5      # start :all, at least once
+    assert any("sudo" in x for x in kills)
+    # completions carry per-node results
+    killed = [o for o in nem_ops if o["f"] == "kill"]
+    assert killed and all(
+        set(o["value"].values()) == {"killed"} for o in killed
+        if isinstance(o["value"], dict))
+
+
+def test_perf_specs_feed_perf_checker():
+    """Package perf specs plug into checker.perf's nemesis partitioning
+    without hand-decoding (the reference passes (:perf pkg) straight to
+    the plot options)."""
+    from jepsen_tpu.checker import perf as cperf
+    pkg = nc.nemesis_package({"db": ProcDB(), "faults": ["kill"]})
+    hist = [{"process": "nemesis", "type": "info", "f": "kill",
+             "value": None, "time": 0, "index": 0},
+            {"process": "nemesis", "type": "info", "f": "start",
+             "value": None, "time": 10 ** 9, "index": 1}]
+    parts = cperf.nemesis_ops(pkg["perf"], hist)
+    names = {p["name"] for p in parts}
+    assert "kill" in names
+
+
+def test_random_nonempty_subset_empty_ok():
+    from jepsen_tpu.util import random_nonempty_subset
+    assert random_nonempty_subset([]) == []

@@ -82,14 +82,16 @@ def test_basic_cas():
         concurrency=10,
         generator=gen.phases(
             {"f": "read"},
-            gen.clients(gen.limit(n, gen.reserve(
+            # barrier: the phase-1 read must *complete* before phase 2's
+            # writes dispatch, or the first ok read may not see 0
+            gen.synchronize(gen.clients(gen.limit(n, gen.reserve(
                 5, gen.repeat({"f": "read"}),
                 gen.mix([
                     lambda: {"f": "write", "value": rng.randint(0, 4)},
                     lambda: {"f": "cas",
                              "value": [rng.randint(0, 4),
                                        rng.randint(0, 4)]},
-                ]))))),
+                ])))))),
     )
     test = core.run(t)
     hist = test["history"]

@@ -1,0 +1,143 @@
+"""Clock nemesis tests: shim compilation command stream, op handling,
+and generator shapes (reference nemesis/time.clj; the C shims themselves
+are compile-checked and exercised locally)."""
+
+import os
+import random
+import re
+import subprocess
+import tempfile
+import time as wall
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu.control.remotes import DummyRemote
+from jepsen_tpu.nemesis import time as nt
+
+RES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "jepsen_tpu", "resources")
+
+
+class ScriptedRemote(DummyRemote):
+    """Dummy remote that answers date/bump-time probes with real-looking
+    clock output and reports the shim binaries as absent."""
+
+    def connect(self, conn_spec):
+        return ScriptedRemote(conn_spec.get("host"), self.log)
+
+    def execute(self, ctx, action):
+        out = super().execute(ctx, action)
+        cmd = out.get("cmd", "")
+        if "test -e" in cmd:
+            out["exit"] = 1   # shims not installed yet
+        elif "date +%s.%N" in cmd:
+            out["out"] = f"{wall.time():.9f}\n"
+        elif "bump-time" in cmd and not cmd.endswith(".c"):
+            out["out"] = f"{wall.time() + 0.5:.9f}\n"
+        elif "strobe-time" in cmd and not cmd.endswith(".c"):
+            out["out"] = "42\n"
+        return out
+
+
+def scripted_test(nodes=("n1", "n2", "n3")):
+    log = []
+    return {"nodes": list(nodes), "remote": ScriptedRemote(log=log),
+            "dummy-log": log}
+
+
+def test_compile_tools_command_stream():
+    test = scripted_test(["n1"])
+    with c.ssh_scope(test), c.on("n1"):
+        nt.compile_tools()
+    cmds = [cmd for _, cmd in test["dummy-log"]]
+    assert any("mkdir -p /opt/jepsen" in x for x in cmds)
+    assert any(x.startswith("upload") and "strobe-time.c" in x for x in cmds)
+    assert any(x.startswith("upload") and "bump-time.c" in x for x in cmds)
+    gccs = [x for x in cmds if "gcc" in x]
+    assert len(gccs) == 2 and all("cd /opt/jepsen" in x for x in gccs)
+
+
+def test_clock_nemesis_invoke_bump_and_check():
+    test = scripted_test()
+    nem = nt.clock_nemesis()
+    with c.ssh_scope(test):
+        nem.setup(test)
+        op = {"type": "info", "process": "nemesis", "f": "bump",
+              "value": {"n1": 4000, "n3": -250}}
+        done = nem.invoke(test, op)
+        check = nem.invoke(test, {"type": "info", "process": "nemesis",
+                                  "f": "check-offsets"})
+        nem.teardown(test)
+    assert set(done["clock_offsets"]) == {"n1", "n3"}
+    assert all(isinstance(v, float) for v in done["clock_offsets"].values())
+    # bump ran the shim only on the targeted nodes
+    bumps = [(h, cmd) for h, cmd in test["dummy-log"]
+             if re.search(r"/opt/jepsen/bump-time '?-?\d", cmd)]
+    assert sorted(h for h, _ in bumps) == ["n1", "n3"]
+    assert any("sudo" in cmd for _, cmd in bumps)
+    assert set(check["clock_offsets"]) == {"n1", "n2", "n3"}
+    # teardown ntpdates every node
+    ntp = [h for h, cmd in test["dummy-log"] if "ntpdate" in cmd]
+    assert set(ntp) >= {"n1", "n2", "n3"}
+
+
+def test_clock_nemesis_strobe_targets_and_args():
+    test = scripted_test()
+    nem = nt.clock_nemesis()
+    with c.ssh_scope(test):
+        nem.setup(test)
+        op = {"type": "info", "process": "nemesis", "f": "strobe",
+              "value": {"n2": {"delta": 100, "period": 5, "duration": 2}}}
+        done = nem.invoke(test, op)
+    strobes = [(h, cmd) for h, cmd in test["dummy-log"]
+               if re.search(r"/opt/jepsen/strobe-time \d", cmd)]
+    assert [h for h, _ in strobes] == ["n2"]
+    assert re.search(r"strobe-time 100 5 2", strobes[0][1])
+    assert set(done["clock_offsets"]) == {"n2"}
+
+
+def test_generators_shapes():
+    rng = random.Random(45100)
+    random.seed(45100)
+    test = {"nodes": ["a", "b", "c", "d", "e"]}
+    r = nt.reset_gen(test, None)
+    assert r["f"] == "reset" and set(r["value"]) <= set(test["nodes"])
+    assert len(r["value"]) >= 1
+    b = nt.bump_gen(test, None)
+    assert b["f"] == "bump"
+    for node, delta in b["value"].items():
+        assert node in test["nodes"]
+        assert 4 <= abs(delta) <= 2 ** 18 * 1.01
+    s = nt.strobe_gen(test, None)
+    assert s["f"] == "strobe"
+    for node, spec in s["value"].items():
+        assert 4 <= spec["delta"] <= 2 ** 18 * 1.01
+        assert 1 <= spec["period"] <= 1024
+        assert 0 <= spec["duration"] <= 32
+
+
+def test_clock_gen_starts_with_check_offsets():
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.generator.testing import perfect, simulate
+    g = gen.limit(5, nt.clock_gen())
+    test = {"nodes": ["n1", "n2"], "concurrency": 1}
+    hist = simulate(test, g, perfect)
+    infos = [o for o in hist if o["type"] == "info"]
+    assert infos[0]["f"] == "check-offsets"
+    assert all(o["f"] in {"check-offsets", "reset", "bump", "strobe"}
+               for o in infos)
+
+
+@pytest.mark.parametrize("src", ["bump-time.c", "strobe-time.c"])
+def test_shims_compile(src):
+    with tempfile.TemporaryDirectory() as d:
+        out = os.path.join(d, "a.out")
+        r = subprocess.run(["gcc", "-Wall", "-Werror", "-O2",
+                            os.path.join(RES, src), "-o", out],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        # running without args prints usage and exits 1
+        r2 = subprocess.run([out], capture_output=True, text=True)
+        assert r2.returncode == 1
+        assert "usage" in r2.stderr

@@ -1,0 +1,245 @@
+"""Transactional-anomaly engine: dependency graphs over transactions,
+cycle detection, and anomaly classification (the build's replacement for
+the external elle engine — reference jepsen/src/jepsen/tests/cycle.clj
+delegates to elle.core/check; see SURVEY.md §2.9).
+
+Design: inference (which txn depends on which) is host-side Python over
+decoded histories; *reachability* — the O(N^3) part — is a dense boolean
+transitive closure computed by repeated squaring of the adjacency matrix,
+jitted so the matmuls land on the MXU. An edge (i, j) closing a cycle is
+then any pair where j reaches i; the actual witness path is reconstructed
+host-side with a BFS over the (tiny) implicated subgraph.
+
+Edge types are a bitmask so one adjacency array carries the whole
+dependency structure:
+
+    WW  write->write   (version succession)
+    WR  write->read    (read observed the write)
+    RW  read->write    (anti-dependency: write replaced what was read)
+    RT  realtime       (a completed before b was invoked)
+
+Anomaly taxonomy (Adya, via elle.list-append's naming):
+
+    G0        cycle of WW edges only
+    G1c       cycle of WW+WR edges with >=1 WR
+    G-single  cycle with exactly one RW edge (rest WW/WR)
+    G2        cycle with >=2 RW edges
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+WW = 1
+WR = 2
+RW = 4
+RT = 8
+
+_EDGE_NAMES = {WW: "ww", WR: "wr", RW: "rw", RT: "rt"}
+
+
+def edge_name(mask: int) -> str:
+    return "+".join(name for bit, name in _EDGE_NAMES.items()
+                    if mask & bit) or "?"
+
+
+class Graph:
+    """A dependency graph over txn indices 0..n-1 with bitmask edges."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.adj = np.zeros((n, n), dtype=np.uint8)
+        # (i, j) -> list of explanation strings
+        self.why: dict[tuple[int, int], list[str]] = {}
+
+    def add(self, i: int, j: int, kind: int, why: str | None = None):
+        if i == j:
+            return
+        self.adj[i, j] |= kind
+        if why is not None:
+            self.why.setdefault((i, j), []).append(why)
+
+    def merge(self, other: "Graph"):
+        assert self.n == other.n
+        self.adj |= other.adj
+        for k, v in other.why.items():
+            self.why.setdefault(k, []).extend(v)
+        return self
+
+    def masked(self, mask: int) -> np.ndarray:
+        return (self.adj & mask) > 0
+
+
+def _bucket_pow2(n: int, lo: int = 64) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+_closure_cache: dict[int, object] = {}
+
+
+def _device_closure(n_pad: int):
+    """Jitted transitive closure by repeated squaring: R |= R@R until
+    fixpoint (log2 n iterations; each squaring is one MXU matmul)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    steps = max(1, int(np.ceil(np.log2(max(2, n_pad)))))
+
+    @jax.jit
+    def run(a):
+        r = a.astype(jnp.float32)
+
+        def body(_, r):
+            rr = (r @ r + r) > 0
+            return rr.astype(jnp.float32)
+
+        r = lax.fori_loop(0, steps, body, r)
+        return r > 0
+
+    return run
+
+
+def transitive_closure(adj: np.ndarray) -> np.ndarray:
+    """Boolean reachability-in->=1-step matrix. Small graphs close on
+    host; larger ones run the jitted squaring kernel (shape-bucketed so
+    compiles are reused)."""
+    n = adj.shape[0]
+    a = adj.astype(bool)
+    if n <= 64:
+        r = a.copy()
+        for _ in range(max(1, int(np.ceil(np.log2(max(2, n)))))):
+            r = r | (r @ r)
+        return r
+    n_pad = _bucket_pow2(n)
+    padded = np.zeros((n_pad, n_pad), dtype=bool)
+    padded[:n, :n] = a
+    fn = _closure_cache.get(n_pad)
+    if fn is None:
+        fn = _device_closure(n_pad)
+        _closure_cache[n_pad] = fn
+    return np.asarray(fn(padded))[:n, :n]
+
+
+def find_path(adj: np.ndarray, src: int, dst: int) -> list[int] | None:
+    """Shortest src->dst path (node list) via BFS on a bool adjacency."""
+    n = adj.shape[0]
+    prev = {src: None}
+    frontier = [src]
+    while frontier:
+        nxt = []
+        for u in frontier:
+            for v in np.flatnonzero(adj[u]):
+                v = int(v)
+                if v not in prev:
+                    prev[v] = u
+                    if v == dst:
+                        path = [v]
+                        while prev[path[-1]] is not None:
+                            path.append(prev[path[-1]])
+                        return path[::-1]
+                    nxt.append(v)
+        frontier = nxt
+    return None
+
+
+def _explain_cycle(graph: Graph, cycle: list[int], ops) -> dict:
+    """Render a cycle (node list, first==last implied) with per-edge
+    types and explanations."""
+    steps = []
+    rws = 0
+    for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+        mask = int(graph.adj[a, b])
+        if mask & RW:
+            rws += 1
+        steps.append({"from": a, "to": b, "type": edge_name(mask),
+                      "why": graph.why.get((a, b), [])})
+    return {"nodes": cycle,
+            "rw_count": rws,
+            "steps": steps,
+            "ops": [dict(ops[i]) for i in cycle]}
+
+
+def _first_cycle(graph: Graph, mask: int, require: int = 0,
+                 closure: np.ndarray | None = None) -> list[int] | None:
+    """Find one cycle in the mask-restricted subgraph; if `require` is
+    set, the cycle must traverse >=1 edge of that type. Returns node
+    list."""
+    sub = graph.masked(mask)
+    if closure is None:
+        closure = transitive_closure(sub)
+    want = graph.masked(require) if require else sub
+    # an edge (i,j) with j ->* i closes a cycle through that edge
+    cand = want & closure.T
+    idx = np.argwhere(cand)
+    if idx.size == 0:
+        return None
+    # prefer the shortest witness
+    best = None
+    for i, j in idx[:64]:
+        back = find_path(sub, int(j), int(i))
+        if back is None:
+            continue
+        cyc = [int(i)] + back[:-1]
+        if best is None or len(cyc) < len(best):
+            best = cyc
+            if len(best) == 2:
+                break
+    return best
+
+
+def check_graph(graph: Graph, ops,
+                anomalies=("G0", "G1c", "G-single", "G2")) -> dict:
+    """Classify cycles in a dependency graph. ops[i] is the op for txn
+    index i (used in witnesses). Returns an elle.core-shaped result:
+    {"valid": bool, "anomaly_types": [...], "anomalies": {type: [...]}}"""
+    found: dict[str, list] = {}
+
+    dep_mask = WW | WR | RW
+    full = transitive_closure(graph.masked(dep_mask))
+
+    # G0: ww-only cycles
+    if "G0" in anomalies:
+        cyc = _first_cycle(graph, WW)
+        if cyc:
+            found["G0"] = [_explain_cycle(graph, cyc, ops)]
+
+    # G1c: ww|wr cycles with at least one wr edge
+    if "G1c" in anomalies:
+        cyc = _first_cycle(graph, WW | WR, require=WR)
+        if cyc:
+            found["G1c"] = [_explain_cycle(graph, cyc, ops)]
+
+    # G-single / G2: cycles with anti-dependency edges. For each rw edge
+    # (i, j): a ww|wr path j ->* i makes it G-single; any dependency path
+    # j ->* i makes it at least G2.
+    want_single = "G-single" in anomalies
+    want_g2 = "G2" in anomalies
+    if want_single or want_g2:
+        wwr = graph.masked(WW | WR)
+        wwr_closure = transitive_closure(wwr)
+        dep = graph.masked(dep_mask)
+        for i, j in np.argwhere(graph.masked(RW)):
+            i, j = int(i), int(j)
+            # one rw + a ww/wr return path -> G-single
+            if want_single and "G-single" not in found \
+                    and (wwr_closure[j, i] or wwr[j, i]):
+                back = find_path(wwr, j, i)
+                if back is not None:
+                    cyc = [i] + back[:-1]
+                    found["G-single"] = [_explain_cycle(graph, cyc, ops)]
+            # a return path that itself needs rw edges -> G2. Checked
+            # independently of G-single: a history can exhibit both.
+            if want_g2 and "G2" not in found and full[j, i]:
+                back = find_path(dep, j, i)
+                if back is not None:
+                    cyc = [i] + back[:-1]
+                    ex = _explain_cycle(graph, cyc, ops)
+                    if ex["rw_count"] >= 2:
+                        found["G2"] = [ex]
+    return {"valid": not found,
+            "anomaly_types": sorted(found),
+            "anomalies": found}

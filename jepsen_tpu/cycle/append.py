@@ -1,0 +1,177 @@
+"""List-append dependency inference: the elle.list-append equivalent
+(reference jepsen/src/jepsen/tests/cycle/append.clj delegates to elle;
+algorithm reconstructed from Adya's formalism + elle's public docs).
+
+Transactions are lists of mops ``["append", k, v]`` / ``["r", k, list]``.
+Appends are unique per key, so every observed list is a *trace* of the
+key's version history:
+
+- version order per key  = the longest observed read (all reads must be
+  prefix-compatible or the history is immediately invalid)
+- WW  A -> B   when B appended the element right after A's in the order
+- WR  A -> R   when R's (external) read of k ends in A's element
+- RW  R -> B   when B appended the element right after the last one R saw
+
+Non-cycle anomalies caught during inference (elle's names):
+
+- incompatible-order  two reads of a key disagree beyond prefixing
+- duplicates          the same element appears twice in one read
+- G1a aborted-read    a read observed an element appended by a failed txn
+- G1b intermediate-read  a read's last element is a txn's *non-final*
+                      append to that key
+- dirty-update        reserved for rw-register (not applicable here)
+"""
+
+from __future__ import annotations
+
+from . import RW, WR, WW, Graph, check_graph
+from .. import history as h
+
+
+def _txn(op):
+    return op.get("value") or []
+
+
+def _external_reads(txn):
+    """(k, list) for each read of k occurring before any append of k in
+    this txn (internal reads — after own appends — observe own effects
+    and aren't evidence about other txns)."""
+    out = []
+    appended = set()
+    for mop in txn:
+        f, k, v = mop[0], mop[1], mop[2]
+        if f == "r":
+            if k not in appended and v is not None:
+                out.append((k, list(v)))
+        else:
+            appended.add(k)
+    return out
+
+
+def _appends(txn):
+    """(k, v) for each append, in txn order."""
+    return [(mop[1], mop[2]) for mop in txn if mop[0] == "append"]
+
+
+def analyze(history, anomalies=("G0", "G1c", "G-single", "G2")) -> dict:
+    """Infer the dependency graph from an append history and classify its
+    anomalies. Returns the check_graph result plus inference-level
+    anomalies."""
+    history = [op for op in history if op.get("f") in ("txn", None)]
+    oks = [op for op in history if op.get("type") == "ok"]
+    fails = [op for op in history if op.get("type") == "fail"]
+    infos = [op for op in history if op.get("type") == "info"]
+
+    idx = {id(op): i for i, op in enumerate(oks)}
+    found: dict[str, list] = {}
+
+    def note(kind, item):
+        found.setdefault(kind, []).append(item)
+
+    # writer maps: element (k, v) -> (owner kind, op, final?) -- among ok
+    # txns; failed/info appends tracked for G1a / indeterminacy
+    writer = {}
+    intermediate = {}
+    for op in oks:
+        per_key = {}
+        for k, v in _appends(_txn(op)):
+            writer[(k, v)] = op
+            per_key.setdefault(k, []).append(v)
+        for k, vs in per_key.items():
+            for v in vs[:-1]:
+                intermediate[(k, v)] = op
+    failed_writer = {}
+    for op in fails:
+        for k, v in _appends(_txn(op)):
+            failed_writer[(k, v)] = op
+    info_writer = {}
+    for op in infos:
+        for k, v in _appends(_txn(op)):
+            info_writer[(k, v)] = op
+
+    # observed reads per key
+    reads_by_key: dict = {}
+    for op in oks:
+        for k, lst in _external_reads(_txn(op)):
+            reads_by_key.setdefault(k, []).append((op, lst))
+            if len(set(lst)) != len(lst):
+                note("duplicates", {"op": dict(op), "key": k,
+                                    "read": lst})
+
+    # version order per key from the longest read; prefix-compatibility
+    version_order: dict = {}
+    for k, reads in reads_by_key.items():
+        longest = max((lst for _, lst in reads), key=len)
+        for op, lst in reads:
+            if lst != longest[:len(lst)]:
+                note("incompatible-order",
+                     {"key": k, "read": lst, "longest": longest,
+                      "op": dict(op)})
+        version_order[k] = longest
+
+    graph = Graph(len(oks))
+
+    for k, order in version_order.items():
+        # WW: consecutive observed appends
+        for a, b in zip(order, order[1:]):
+            wa, wb = writer.get((k, a)), writer.get((k, b))
+            if wa is not None and wb is not None and wa is not wb:
+                graph.add(idx[id(wa)], idx[id(wb)], WW,
+                          f"{k}: append {a} precedes append {b}")
+        # aborted / garbage reads
+        for v in order:
+            if (k, v) in writer or (k, v) in info_writer:
+                continue
+            if (k, v) in failed_writer:
+                note("G1a", {"key": k, "value": v,
+                             "writer": dict(failed_writer[(k, v)])})
+            else:
+                note("garbage-read", {"key": k, "value": v})
+
+    for op in oks:
+        for k, lst in _external_reads(_txn(op)):
+            order = version_order.get(k, [])
+            if lst:
+                last = lst[-1]
+                w = writer.get((k, last))
+                if w is not None and w is not op:
+                    graph.add(idx[id(w)], idx[id(op)], WR,
+                              f"{k}: read ends in {last} appended by it")
+                if (k, last) in intermediate and \
+                        intermediate[(k, last)] is not op:
+                    note("G1b", {"key": k, "value": last,
+                                 "op": dict(op),
+                                 "writer": dict(intermediate[(k, last)])})
+            # RW: whoever appended the next version overwrote what we saw
+            pos = len(lst)
+            if pos < len(order):
+                nxt = order[pos]
+                wn = writer.get((k, nxt))
+                if wn is not None and wn is not op:
+                    graph.add(idx[id(op)], idx[id(wn)], RW,
+                              f"{k}: read ended at {lst[-1] if lst else '[]'}"
+                              f"; {nxt} was appended next")
+
+    res = check_graph(graph, oks, anomalies)
+    res["anomalies"].update(found)
+    res["anomaly_types"] = sorted(set(res["anomaly_types"]) |
+                                  (set(found) - {"garbage-read"}))
+    if res["anomaly_types"]:
+        res["valid"] = False
+    elif found.get("garbage-read"):
+        # reads observed elements nobody is known to have appended --
+        # could be a concurrent info txn we can't index; indeterminate
+        res["valid"] = "unknown"
+        res["anomalies"]["garbage-read"] = found["garbage-read"]
+    return res
+
+
+def check(history, opts=None) -> dict:
+    """Checker entry: complete invoke/ok pairs are analyzed; returns
+    {"valid": ..., "anomaly_types": [...], "anomalies": {...}}."""
+    opts = opts or {}
+    anomalies = tuple(opts.get("anomalies",
+                               ("G0", "G1c", "G-single", "G2")))
+    res = analyze(h.complete(history), anomalies)
+    res["valid?"] = res["valid"]
+    return res

@@ -1,0 +1,130 @@
+"""Write/read register dependency inference: the elle.rw-register
+equivalent (reference jepsen/src/jepsen/tests/cycle/wr.clj delegates to
+elle). Writes are assumed unique per (key, value).
+
+Without observed version traces (unlike list-append), version order must
+be *assumed* into existence. Supported inference, mirroring elle's
+documented options:
+
+- WR edges always: the writer of v -> every txn that externally read v.
+- ``sequential_keys``: each key is sequentially consistent; derive a
+  per-key version order from each process's observation order.
+- ``linearizable_keys``: each key is linearizable; derive version order
+  from realtime order of the writes (completion of A before invocation
+  of B). Adds WW and RW edges along that order.
+
+Non-cycle anomalies: G1a (read a failed txn's write), G1b (read a
+non-final write of some txn), dirty-update-ish lost writes are left to
+the register checkers."""
+
+from __future__ import annotations
+
+from . import RW, WR, WW, Graph, check_graph
+from .. import history as h
+from ..txn import ext_reads, ext_writes, int_write_mops
+
+
+def _txn(op):
+    return op.get("value") or []
+
+
+def analyze(history, opts=None) -> dict:
+    opts = opts or {}
+    anomalies = tuple(opts.get("anomalies",
+                               ("G0", "G1c", "G-single", "G2")))
+    history = [op for op in history if op.get("f") in ("txn", None)]
+    # realtime precedence needs invocation times; pair them up before
+    # dropping invokes (completion-only test histories fall back to
+    # treating ops as point events)
+    inv_time = {}
+    for inv, comp in h.pairs(history):
+        if inv is not None and comp is not None:
+            inv_time[id(comp)] = inv.get("time", comp.get("time", 0))
+    oks = [op for op in history if op.get("type") == "ok"]
+    fails = [op for op in history if op.get("type") == "fail"]
+
+    def invoked_at(op):
+        return inv_time.get(id(op), op.get("time", 0))
+
+    def precedes(a, b):
+        """True realtime precedence: a completed before b was invoked."""
+        return a.get("time", 0) < invoked_at(b)
+
+    idx = {id(op): i for i, op in enumerate(oks)}
+    found: dict[str, list] = {}
+
+    writer = {}          # (k, v) -> op with final write v to k
+    intermediate = {}    # (k, v) -> op which wrote v non-finally
+    for op in oks:
+        for k, v in ext_writes(_txn(op)).items():
+            writer[(k, v)] = op
+        for k, mops in int_write_mops(_txn(op)).items():
+            for mop in mops:
+                intermediate[(k, mop[2])] = op
+    failed_writer = {}
+    for op in fails:
+        for k, v in ext_writes(_txn(op)).items():
+            failed_writer[(k, v)] = op
+
+    graph = Graph(len(oks))
+
+    for op in oks:
+        for k, v in ext_reads(_txn(op)).items():
+            if v is None:
+                continue
+            w = writer.get((k, v))
+            if w is not None:
+                if w is not op:
+                    graph.add(idx[id(w)], idx[id(op)], WR,
+                              f"{k}: read {v} written by it")
+            elif (k, v) in intermediate:
+                found.setdefault("G1b", []).append(
+                    {"key": k, "value": v, "op": dict(op),
+                     "writer": dict(intermediate[(k, v)])})
+            elif (k, v) in failed_writer:
+                found.setdefault("G1a", []).append(
+                    {"key": k, "value": v, "op": dict(op),
+                     "writer": dict(failed_writer[(k, v)])})
+
+    if opts.get("linearizable_keys"):
+        # Under per-key linearizability the version order embeds the
+        # realtime order, so a->b edges are sound exactly when a
+        # *completed* before b was *invoked*; genuinely concurrent
+        # writes get no edge (ordering them by completion time alone
+        # manufactures false cycles).
+        by_key: dict = {}
+        for op in oks:
+            for k in ext_writes(_txn(op)):
+                by_key.setdefault(k, []).append(op)
+        for k, writers in by_key.items():
+            for a in writers:
+                for b in writers:
+                    if a is not b and precedes(a, b):
+                        graph.add(idx[id(a)], idx[id(b)], WW,
+                                  f"{k}: write realtime order "
+                                  "(linearizable-keys)")
+        # RW: a read of a's version anti-depends on every write
+        # realtime-after a (all their versions are later than a's)
+        for op in oks:
+            for k, v in ext_reads(_txn(op)).items():
+                a = writer.get((k, v))
+                if a is None:
+                    continue
+                for b in by_key.get(k, ()):
+                    if b is not a and b is not op and precedes(a, b):
+                        graph.add(idx[id(op)], idx[id(b)], RW,
+                                  f"{k}: read {v}, overwritten by a "
+                                  "realtime-later write")
+
+    res = check_graph(graph, oks, anomalies)
+    res["anomalies"].update(found)
+    res["anomaly_types"] = sorted(set(res["anomaly_types"]) | set(found))
+    if res["anomaly_types"]:
+        res["valid"] = False
+    return res
+
+
+def check(history, opts=None) -> dict:
+    res = analyze(h.complete(history), opts)
+    res["valid?"] = res["valid"]
+    return res

@@ -132,7 +132,10 @@ def db_package(opts):
          if gens["generator"] is not None else None)
     return {"generator": g if needed else None,
             "final_generator": gens["final_generator"] if needed else None,
-            "nemesis": DbNemesis(opts["db"]),
+            # unlike the reference (combined.clj:152, which wires the
+            # nemesis unconditionally), a disabled package contributes no
+            # nemesis: its setup must not touch the nodes
+            "nemesis": DbNemesis(opts["db"]) if needed else None,
             "perf": {_perf(name="kill", start={"kill"}, stop={"start"},
                            color="#E9A4A0"),
                      _perf(name="pause", start={"pause"}, stop={"resume"},
@@ -232,7 +235,7 @@ def partition_package(opts):
                     gen.flip_flop(start, gen.repeat(stop)))
     return {"generator": g if needed else None,
             "final_generator": stop if needed else None,
-            "nemesis": PartitionNemesis(db),
+            "nemesis": PartitionNemesis(db) if needed else None,
             "perf": {_perf(name="partition", start={"start-partition"},
                            stop={"stop-partition"}, color="#E9DCA0")}}
 
@@ -242,10 +245,13 @@ def clock_package(opts):
     (combined.clj:248-280)."""
     needed = "clock" in opts["faults"]
     db = opts["db"]
+    # a disabled clock package must not install shims / stop ntpd at
+    # setup, so it contributes no nemesis at all
     nemesis = n_compose({(("reset-clock", "reset"),
                           ("check-clock-offsets", "check-offsets"),
                           ("strobe-clock", "strobe"),
-                          ("bump-clock", "bump")): nt.clock_nemesis()})
+                          ("bump-clock", "bump")): nt.clock_nemesis()}) \
+        if needed else None
     target_specs = opts.get("clock", {}).get("targets") or node_specs(db)
 
     def targets(test):
@@ -290,6 +296,8 @@ def f_map(lift, pkg):
     if isinstance(lift, dict):
         d = dict(lift)
         lift = lambda f: d.get(f, f)  # noqa: E731
+    if pkg["nemesis"] is None:
+        return dict(pkg, perf=f_map_perf(lift, pkg["perf"]))
     fm = {f: lift(f) for f in pkg["nemesis"].fs()}
     return {"generator": (gen.f_map(fm, pkg["generator"])
                           if pkg["generator"] is not None else None),
@@ -307,13 +315,16 @@ def compose_packages(packages):
     if not packages:
         return noop
     if len(packages) == 1:
-        return packages[0]
+        pkg = dict(packages[0])
+        if pkg.get("nemesis") is None:
+            pkg["nemesis"] = nemesis_noop
+        return pkg
+    nems = [p["nemesis"] for p in packages if p["nemesis"] is not None]
     return {"generator": gen.any(*[p["generator"] for p in packages
                                    if p["generator"] is not None]),
             "final_generator": [p["final_generator"] for p in packages
                                 if p["final_generator"] is not None],
-            "nemesis": n_compose([p["nemesis"] for p in packages
-                                  if p["nemesis"] is not None]),
+            "nemesis": n_compose(nems) if nems else nemesis_noop,
             "perf": set().union(*[p["perf"] for p in packages])}
 
 

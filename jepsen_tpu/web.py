@@ -147,7 +147,8 @@ class Handler(BaseHTTPRequestHandler):
                 # dir pages use relative links; force the trailing slash
                 # so they resolve against this directory
                 self.send_response(301)
-                self.send_header("Location", f"/files/{rel}/")
+                self.send_header("Location",
+                                 f"/files/{urllib.parse.quote(rel)}/")
                 self.end_headers()
                 return None
             return self._send(200, _dir_page(rel.strip("/"), full))

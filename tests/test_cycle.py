@@ -1,0 +1,313 @@
+"""Golden-history tests for the txn library and the elle-equivalent
+cycle engine (reference: elle's documented anomaly taxonomy; jepsen's
+cycle workloads delegate there, cycle/append.clj:11-27)."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import txn as t
+from jepsen_tpu.cycle import (RW, WR, WW, Graph, append as ap,
+                              check_graph, transitive_closure, wr as wrx)
+from jepsen_tpu.tests.cycle import append as ap_wl, wr as wr_wl
+
+
+# -- jepsen.txn --------------------------------------------------------------
+
+def test_ext_reads():
+    assert t.ext_reads([["r", "x", 1], ["w", "x", 2],
+                        ["r", "x", 2]]) == {"x": 1}
+    assert t.ext_reads([["w", "x", 2], ["r", "x", 2]]) == {}
+    assert t.ext_reads([["r", "y", 3]]) == {"y": 3}
+
+
+def test_ext_writes():
+    assert t.ext_writes([["w", "x", 1], ["w", "x", 2],
+                         ["r", "y", 0]]) == {"x": 2}
+    assert t.ext_writes([["r", "x", 1]]) == {}
+
+
+def test_int_write_mops():
+    assert t.int_write_mops([["w", "x", 1], ["w", "x", 2]]) == \
+        {"x": [["w", "x", 1]]}
+    assert t.int_write_mops([["w", "x", 1], ["w", "y", 2]]) == {}
+
+
+def test_reduce_mops_and_op_mops():
+    hist = [{"value": [["r", "x", 1], ["w", "x", 2]]},
+            {"value": [["w", "y", 3]]}]
+    total = t.reduce_mops(lambda s, op, mop: s + 1, 0, hist)
+    assert total == 3
+    assert len(list(t.op_mops(hist))) == 3
+
+
+# -- graph engine ------------------------------------------------------------
+
+def test_transitive_closure_host_vs_device():
+    rng = np.random.default_rng(45100)
+    n = 100   # > 64 forces the jitted repeated-squaring path
+    adj = rng.random((n, n)) < 0.03
+    np.fill_diagonal(adj, False)
+    got = transitive_closure(adj)
+    # reference: iterative host closure
+    want = adj.copy()
+    for _ in range(8):
+        want = want | (want @ want)
+    assert np.array_equal(got, want)
+
+
+def test_check_graph_classifications():
+    ops = [{"index": i} for i in range(3)]
+    # pure ww cycle
+    g = Graph(3)
+    g.add(0, 1, WW)
+    g.add(1, 0, WW)
+    res = check_graph(g, ops)
+    assert res["anomaly_types"] == ["G0"]
+    # ww+wr cycle
+    g = Graph(3)
+    g.add(0, 1, WW)
+    g.add(1, 2, WR)
+    g.add(2, 0, WW)
+    res = check_graph(g, ops)
+    assert "G1c" in res["anomaly_types"] and "G0" not in res["anomaly_types"]
+    # one rw -> G-single
+    g = Graph(3)
+    g.add(0, 1, RW)
+    g.add(1, 0, WR)
+    res = check_graph(g, ops)
+    assert "G-single" in res["anomaly_types"]
+    # two rw -> G2
+    g = Graph(3)
+    g.add(0, 1, RW)
+    g.add(1, 0, RW)
+    res = check_graph(g, ops)
+    assert res["anomaly_types"] == ["G2"]
+    # acyclic
+    g = Graph(3)
+    g.add(0, 1, WW)
+    g.add(1, 2, RW)
+    assert check_graph(g, ops)["valid"] is True
+
+
+# -- list-append inference ---------------------------------------------------
+
+def H(*txns):
+    """Build ok ops from txn mop-lists (with optional type override)."""
+    out = []
+    for i, txn in enumerate(txns):
+        typ = "ok"
+        if isinstance(txn, tuple):
+            typ, txn = txn
+        out.append({"type": typ, "f": "txn", "process": i,
+                    "time": i * 10, "index": i, "value": txn})
+    return out
+
+
+def test_append_valid_serial():
+    hist = H([["append", "x", 1]],
+             [["r", "x", [1]], ["append", "x", 2]],
+             [["r", "x", [1, 2]]])
+    res = ap.analyze(hist)
+    assert res["valid"] is True
+
+
+def test_append_g0_write_cycle():
+    hist = H([["append", "x", 1], ["append", "y", 1]],
+             [["append", "x", 2], ["append", "y", 2]],
+             [["r", "x", [1, 2]], ["r", "y", [2, 1]]])
+    res = ap.analyze(hist)
+    assert "G0" in res["anomaly_types"]
+    assert res["valid"] is False
+    cyc = res["anomalies"]["G0"][0]
+    assert all("ww" in s["type"] for s in cyc["steps"])
+
+
+def test_append_g1c_wr_cycle():
+    hist = H([["r", "y", [1]], ["append", "x", 1]],
+             [["r", "x", [1]], ["append", "y", 1]])
+    res = ap.analyze(hist)
+    assert "G1c" in res["anomaly_types"]
+
+
+def test_append_g_single():
+    hist = H([["append", "x", 1], ["append", "y", 1]],
+             [["r", "x", []], ["r", "y", [1]]],
+             [["r", "x", [1]]])
+    res = ap.analyze(hist)
+    assert "G-single" in res["anomaly_types"]
+    assert res["anomalies"]["G-single"][0]["rw_count"] == 1
+
+
+def test_append_g2_write_skew():
+    hist = H([["r", "x", []], ["append", "y", 1]],
+             [["r", "y", []], ["append", "x", 1]],
+             [["r", "x", [1]], ["r", "y", [1]]])
+    res = ap.analyze(hist)
+    assert "G2" in res["anomaly_types"]
+    assert res["anomalies"]["G2"][0]["rw_count"] >= 2
+
+
+def test_append_g1a_aborted_read():
+    hist = H(("fail", [["append", "x", 9]]),
+             [["r", "x", [9]]])
+    res = ap.analyze(hist)
+    assert "G1a" in res["anomaly_types"]
+
+
+def test_append_g1b_intermediate_read():
+    hist = H([["append", "x", 1], ["append", "x", 2]],
+             [["r", "x", [1]]])
+    res = ap.analyze(hist)
+    assert "G1b" in res["anomaly_types"]
+
+
+def test_append_incompatible_order():
+    hist = H([["r", "x", [1, 2]]],
+             [["r", "x", [2, 1]]],
+             [["append", "x", 1]],
+             [["append", "x", 2]])
+    res = ap.analyze(hist)
+    assert "incompatible-order" in res["anomaly_types"]
+
+
+def test_append_duplicates():
+    hist = H([["append", "x", 1]],
+             [["r", "x", [1, 1]]])
+    res = ap.analyze(hist)
+    assert "duplicates" in res["anomaly_types"]
+
+
+def test_append_garbage_read_is_unknown():
+    hist = H([["r", "x", [5]]])
+    res = ap.analyze(hist)
+    assert res["valid"] == "unknown"
+
+
+def test_append_info_append_observed_is_ok():
+    hist = H(("info", [["append", "x", 1]]),
+             [["r", "x", [1]]])
+    res = ap.analyze(hist)
+    assert res["valid"] is True
+
+
+# -- wr register inference ---------------------------------------------------
+
+def test_wr_g1c_read_cycle():
+    hist = H([["r", "y", 1], ["w", "x", 1]],
+             [["r", "x", 1], ["w", "y", 1]])
+    res = wrx.analyze(hist)
+    assert "G1c" in res["anomaly_types"]
+
+
+def test_wr_g1a_and_g1b():
+    hist = H(("fail", [["w", "x", 5]]),
+             [["r", "x", 5]])
+    assert "G1a" in wrx.analyze(hist)["anomaly_types"]
+    hist = H([["w", "x", 1], ["w", "x", 2]],
+             [["r", "x", 1]])
+    assert "G1b" in wrx.analyze(hist)["anomaly_types"]
+
+
+def test_wr_linearizable_keys_g_single():
+    hist = H([["w", "x", 1]],
+             [["w", "y", 2], ["w", "x", 2]],
+             [["r", "y", 2], ["r", "x", 1]])
+    res = wrx.analyze(hist, {"linearizable_keys": True})
+    assert "G-single" in res["anomaly_types"]
+
+
+def test_wr_valid():
+    hist = H([["w", "x", 1]],
+             [["r", "x", 1], ["w", "y", 1]],
+             [["r", "y", 1]])
+    res = wrx.analyze(hist, {"linearizable_keys": True})
+    assert res["valid"] is True
+
+
+# -- workload wrappers -------------------------------------------------------
+
+def test_append_workload_generator_and_checker():
+    import random
+    random.seed(45100)
+    wl = ap_wl.test({"key-count": 2, "max-writes-per-key": 4})
+    g = wl["generator"]
+    seen_vals = {}
+    for _ in range(50):
+        op = g(None, None)
+        assert op["f"] == "txn"
+        for mop in op["value"]:
+            f, k, v = mop
+            assert f in ("append", "r")
+            if f == "append":
+                # appends are unique per key and ascending
+                assert v > seen_vals.get(k, 0)
+                seen_vals[k] = v
+    # checker plugs into the Checker protocol
+    hist = H([["append", 0, 1]], [["r", 0, [1]]])
+    res = wl["checker"].check({}, hist)
+    assert res["valid"] is True
+
+
+def test_wr_workload_generator():
+    import random
+    random.seed(45100)
+    g = wr_wl.gen({"key-count": 2})
+    op = g(None, None)
+    assert all(m[0] in ("w", "r") for m in op["value"])
+
+
+def test_check_graph_reports_g2_alongside_g_single():
+    """A G-single cycle must not mask an independent write-skew (G2)
+    cycle elsewhere in the graph."""
+    ops = [{"index": i} for i in range(4)]
+    g = Graph(4)
+    g.add(0, 1, RW)
+    g.add(1, 0, WR)   # G-single: 0->1 rw, 1->0 wr
+    g.add(2, 3, RW)
+    g.add(3, 2, RW)   # G2: pure anti-dependency cycle
+    res = check_graph(g, ops)
+    assert "G-single" in res["anomaly_types"]
+    assert "G2" in res["anomaly_types"]
+
+
+def test_wr_linearizable_keys_concurrent_writes_no_false_cycle():
+    """Writes whose executions overlap in realtime must not be ordered by
+    completion time (that fabricates cycles on valid histories)."""
+    hist = [
+        {"type": "invoke", "process": 0, "f": "txn", "time": 0,
+         "value": [["w", "x", 1]]},
+        {"type": "invoke", "process": 1, "f": "txn", "time": 1,
+         "value": [["r", "x", None], ["w", "x", 2]]},
+        {"type": "ok", "process": 1, "f": "txn", "time": 5,
+         "value": [["r", "x", 1], ["w", "x", 2]]},
+        {"type": "ok", "process": 0, "f": "txn", "time": 10,
+         "value": [["w", "x", 1]]},
+    ]
+    res = wrx.check(hist, {"linearizable_keys": True})
+    assert res["valid"] is True
+
+
+def test_clock_package_disabled_contributes_no_nemesis():
+    """faults=['kill'] must not set up the clock nemesis (no gcc install
+    / ntpd stop / clock reset on nodes that only asked for kills)."""
+    from jepsen_tpu import control as c
+    from jepsen_tpu.nemesis import combined as nc
+
+    class D:
+        pass
+
+    from jepsen_tpu import db as jdb
+
+    class KDB(jdb.DB, jdb.Process):
+        def setup(self, t, n): pass
+        def teardown(self, t, n): pass
+        def start(self, t, n): pass
+        def kill(self, t, n): pass
+
+    pkg = nc.nemesis_package({"db": KDB(), "faults": ["kill"]})
+    assert not any("clock" in f for f in pkg["nemesis"].fs())
+    test = {"nodes": ["n1"], "ssh": {"dummy?": True}}
+    with c.ssh_scope(test):
+        pkg["nemesis"].setup(test)
+    cmds = [cmd for _, cmd in test.get("dummy-log", [])]
+    assert not any("ntpdate" in x or "gcc" in x for x in cmds)

@@ -197,9 +197,7 @@ def check_graph(graph: Graph, ops,
     index i (used in witnesses). Returns an elle.core-shaped result:
     {"valid": bool, "anomaly_types": [...], "anomalies": {type: [...]}}"""
     found: dict[str, list] = {}
-
     dep_mask = WW | WR | RW
-    full = transitive_closure(graph.masked(dep_mask))
 
     # G0: ww-only cycles
     if "G0" in anomalies:
@@ -218,11 +216,15 @@ def check_graph(graph: Graph, ops,
     # j ->* i makes it at least G2.
     want_single = "G-single" in anomalies
     want_g2 = "G2" in anomalies
-    if want_single or want_g2:
+    rw_edges = np.argwhere(graph.masked(RW))
+    if (want_single or want_g2) and len(rw_edges):
+        # closures are the O(n^3) part; only pay for them when rw edges
+        # exist and the corresponding anomaly class was requested
         wwr = graph.masked(WW | WR)
         wwr_closure = transitive_closure(wwr)
         dep = graph.masked(dep_mask)
-        for i, j in np.argwhere(graph.masked(RW)):
+        full = transitive_closure(dep) if want_g2 else None
+        for i, j in rw_edges:
             i, j = int(i), int(j)
             # one rw + a ww/wr return path -> G-single
             if want_single and "G-single" not in found \

@@ -7,8 +7,9 @@ be *assumed* into existence. Supported inference, mirroring elle's
 documented options:
 
 - WR edges always: the writer of v -> every txn that externally read v.
-- ``sequential_keys``: each key is sequentially consistent; derive a
-  per-key version order from each process's observation order.
+- ``sequential_keys``: each key is sequentially consistent; a process
+  touching version a of k before version b witnesses a < b, yielding
+  WW/RW edges between their writers and readers.
 - ``linearizable_keys``: each key is linearizable; derive version order
   from realtime order of the writes (completion of A before invocation
   of B). Adds WW and RW edges along that order.
@@ -65,8 +66,13 @@ def analyze(history, opts=None) -> dict:
     for op in fails:
         for k, v in ext_writes(_txn(op)).items():
             failed_writer[(k, v)] = op
+    info_writer = {}
+    for op in [o for o in history if o.get("type") == "info"]:
+        for k, v in ext_writes(_txn(op)).items():
+            info_writer[(k, v)] = op
 
     graph = Graph(len(oks))
+    garbage = []
 
     for op in oks:
         for k, v in ext_reads(_txn(op)).items():
@@ -85,6 +91,53 @@ def analyze(history, opts=None) -> dict:
                 found.setdefault("G1a", []).append(
                     {"key": k, "value": v, "op": dict(op),
                      "writer": dict(failed_writer[(k, v)])})
+            elif (k, v) in info_writer:
+                # indeterminate write observed: proves it committed, but
+                # the writer isn't an indexable ok txn -- no edge
+                pass
+            else:
+                garbage.append({"key": k, "value": v, "op": dict(op)})
+
+    if opts.get("sequential_keys"):
+        # Each key is sequentially consistent: every process observes
+        # versions of k in the (single) version order. A process that
+        # touched version a of k in an earlier op and version b in a
+        # later op therefore witnesses a < b.
+        by_key: dict = {}
+        for op in oks:
+            for k in ext_writes(_txn(op)):
+                by_key.setdefault(k, []).append(op)
+        by_process: dict = {}
+        for op in oks:
+            by_process.setdefault(op.get("process"), []).append(op)
+        before: dict = {}   # (k, va, vb): va witnessed before vb
+        for p, pops in by_process.items():
+            last_seen: dict = {}
+            for op in pops:
+                touched = dict(ext_reads(_txn(op)))
+                touched.update(ext_writes(_txn(op)))
+                for k, v in touched.items():
+                    if v is None:
+                        continue
+                    prev = last_seen.get(k)
+                    if prev is not None and prev != v:
+                        before[(k, prev, v)] = True
+                    last_seen[k] = v
+        for (k, va, vb) in before:
+            a, b = writer.get((k, va)), writer.get((k, vb))
+            if a is not None and b is not None and a is not b:
+                graph.add(idx[id(a)], idx[id(b)], WW,
+                          f"{k}: {va} observed before {vb} "
+                          "(sequential-keys)")
+            # anyone who read va anti-depends on vb's writer
+            if b is not None:
+                for op in oks:
+                    if op is b:
+                        continue
+                    if ext_reads(_txn(op)).get(k) == va:
+                        graph.add(idx[id(op)], idx[id(b)], RW,
+                                  f"{k}: read {va}; {vb} written after "
+                                  "(sequential-keys)")
 
     if opts.get("linearizable_keys"):
         # Under per-key linearizability the version order embeds the
@@ -121,6 +174,10 @@ def analyze(history, opts=None) -> dict:
     res["anomaly_types"] = sorted(set(res["anomaly_types"]) | set(found))
     if res["anomaly_types"]:
         res["valid"] = False
+    elif garbage:
+        # reads observed values nobody is known to have written
+        res["valid"] = "unknown"
+        res["anomalies"]["garbage-read"] = garbage
     return res
 
 

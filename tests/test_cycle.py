@@ -311,3 +311,30 @@ def test_clock_package_disabled_contributes_no_nemesis():
         pkg["nemesis"].setup(test)
     cmds = [cmd for _, cmd in test.get("dummy-log", [])]
     assert not any("ntpdate" in x or "gcc" in x for x in cmds)
+
+
+def test_wr_sequential_keys_detects_order_disagreement():
+    """Two processes observing x's versions in opposite orders is a ww
+    cycle under the sequential-keys assumption."""
+    hist = H([["w", "x", 1]],
+             [["w", "x", 2]],
+             [["r", "x", 1]],
+             [["r", "x", 2]],
+             [["r", "x", 2]],
+             [["r", "x", 1]])
+    # processes: H assigns process=i; regroup so p4 sees 1 then 2 and
+    # p5 sees 2 then 1
+    hist[2]["process"] = hist[3]["process"] = 4
+    hist[4]["process"] = hist[5]["process"] = 5
+    res = wrx.analyze(hist, {"sequential_keys": True})
+    assert res["valid"] is False
+    assert "G0" in res["anomaly_types"] or "G2" in res["anomaly_types"]
+
+
+def test_wr_garbage_read_unknown():
+    hist = H([["r", "x", 99]])
+    res = wrx.analyze(hist)
+    assert res["valid"] == "unknown"
+    hist = H(("info", [["w", "x", 7]]),
+             [["r", "x", 7]])
+    assert wrx.analyze(hist)["valid"] is True

@@ -1,0 +1,211 @@
+"""Golden-history tests for the long-fork, causal, causal-reverse, and
+adya workloads (reference tests/{long_fork,causal,causal_reverse,
+adya}.clj)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.generator.testing import perfect, simulate
+from jepsen_tpu.tests import adya, causal, causal_reverse, long_fork
+
+
+# -- long fork ---------------------------------------------------------------
+
+def _read(txn, **kw):
+    return {"type": "ok", "f": "read", "process": kw.get("process", 0),
+            "value": txn, "time": kw.get("time", 0)}
+
+
+def test_long_fork_detects_fork():
+    hist = [
+        {"type": "ok", "f": "write", "process": 0,
+         "value": [["w", 0, 1]], "time": 0},
+        {"type": "ok", "f": "write", "process": 1,
+         "value": [["w", 1, 1]], "time": 1},
+        _read([["r", 0, 1], ["r", 1, None]], process=2, time=2),
+        _read([["r", 0, None], ["r", 1, 1]], process=3, time=3),
+    ]
+    res = long_fork.checker(2).check({}, hist)
+    assert res["valid"] is False
+    assert len(res["forks"]) == 1
+
+
+def test_long_fork_valid_comparable_reads():
+    hist = [
+        _read([["r", 0, 1], ["r", 1, None]], process=0),
+        _read([["r", 0, 1], ["r", 1, 1]], process=1),
+        _read([["r", 0, None], ["r", 1, None]], process=2),
+    ]
+    res = long_fork.checker(2).check({}, hist)
+    assert res["valid"] is True
+    assert res["reads-count"] == 3
+    assert res["early-read-count"] == 1
+    assert res["late-read-count"] == 1
+
+
+def test_long_fork_multiple_writes_unknown():
+    hist = [
+        {"type": "invoke", "f": "write", "process": 0,
+         "value": [["w", 7, 1]], "time": 0},
+        {"type": "invoke", "f": "write", "process": 1,
+         "value": [["w", 7, 1]], "time": 1},
+    ]
+    res = long_fork.checker(2).check({}, hist)
+    assert res["valid"] == "unknown"
+    assert res["error"][0] == "multiple-writes"
+
+
+def test_long_fork_distinct_values_unknown():
+    hist = [
+        _read([["r", 0, 1], ["r", 1, None]], process=0),
+        _read([["r", 0, 2], ["r", 1, None]], process=1),
+    ]
+    res = long_fork.checker(2).check({}, hist)
+    assert res["valid"] == "unknown"
+
+
+def test_long_fork_generator_shape():
+    random.seed(45100)
+    test = {"nodes": ["n1", "n2"], "concurrency": 4}
+    hist = simulate(test, gen.limit(40, long_fork.generator(2)), perfect)
+    invokes = [o for o in hist if o["type"] == "invoke"]
+    writes = [o for o in invokes if o["f"] == "write"]
+    reads = [o for o in invokes if o["f"] == "read"]
+    assert writes and reads
+    # writes use unique fresh keys
+    wkeys = [o["value"][0][1] for o in writes]
+    assert len(set(wkeys)) == len(wkeys)
+    # every read covers a full group of 2
+    assert all(len({m[1] for m in o["value"]}) == 2 for o in reads)
+
+
+# -- causal ------------------------------------------------------------------
+
+def _c(f, value, pos, link, typ="ok"):
+    return {"type": typ, "f": f, "value": value, "position": pos,
+            "link": link, "process": 0, "time": pos}
+
+
+def test_causal_valid_chain():
+    hist = [
+        _c("read-init", None, 1, "init"),
+        _c("write", 1, 2, 1),
+        _c("read", 1, 3, 2),
+        _c("write", 2, 4, 3),
+        _c("read", 2, 5, 4),
+    ]
+    res = causal.check(causal.causal_register()).check({}, hist)
+    assert res["valid"] is True
+
+
+def test_causal_broken_link():
+    hist = [
+        _c("read-init", None, 1, "init"),
+        _c("write", 1, 2, 99),   # links to a position never seen
+    ]
+    res = causal.check(causal.causal_register()).check({}, hist)
+    assert res["valid"] is False
+    assert "Cannot link" in res["error"]
+
+
+def test_causal_stale_read():
+    hist = [
+        _c("read-init", None, 1, "init"),
+        _c("write", 1, 2, 1),
+        _c("write", 2, 3, 2),
+        _c("read", 1, 4, 3),     # stale: register is now 2
+    ]
+    res = causal.check(causal.causal_register()).check({}, hist)
+    assert res["valid"] is False
+
+
+def test_causal_bad_write_value():
+    hist = [
+        _c("read-init", None, 1, "init"),
+        _c("write", 7, 2, 1),    # expected counter value 1
+    ]
+    res = causal.check(causal.causal_register()).check({}, hist)
+    assert res["valid"] is False
+
+
+# -- causal reverse ----------------------------------------------------------
+
+def test_causal_reverse_detects_reversal():
+    hist = [
+        {"type": "invoke", "f": "write", "value": 0, "process": 0},
+        {"type": "ok", "f": "write", "value": 0, "process": 0},
+        # w1 invoked after w0 completed: w0 must be visible wherever w1 is
+        {"type": "invoke", "f": "write", "value": 1, "process": 1},
+        {"type": "ok", "f": "write", "value": 1, "process": 1},
+        {"type": "invoke", "f": "read", "value": None, "process": 2},
+        {"type": "ok", "f": "read", "value": [1], "process": 2},
+    ]
+    res = causal_reverse.checker().check({}, hist)
+    assert res["valid"] is False
+    assert res["errors"][0]["missing"] == [0]
+
+
+def test_causal_reverse_valid():
+    hist = [
+        {"type": "invoke", "f": "write", "value": 0, "process": 0},
+        {"type": "ok", "f": "write", "value": 0, "process": 0},
+        {"type": "invoke", "f": "write", "value": 1, "process": 1},
+        {"type": "ok", "f": "write", "value": 1, "process": 1},
+        {"type": "invoke", "f": "read", "value": None, "process": 2},
+        {"type": "ok", "f": "read", "value": [0, 1], "process": 2},
+    ]
+    assert causal_reverse.checker().check({}, hist)["valid"] is True
+
+
+def test_causal_reverse_concurrent_writes_ok():
+    # w0 and w1 overlap; a read may see either subset
+    hist = [
+        {"type": "invoke", "f": "write", "value": 0, "process": 0},
+        {"type": "invoke", "f": "write", "value": 1, "process": 1},
+        {"type": "ok", "f": "write", "value": 0, "process": 0},
+        {"type": "ok", "f": "write", "value": 1, "process": 1},
+        {"type": "invoke", "f": "read", "value": None, "process": 2},
+        {"type": "ok", "f": "read", "value": [1], "process": 2},
+    ]
+    assert causal_reverse.checker().check({}, hist)["valid"] is True
+
+
+# -- adya --------------------------------------------------------------------
+
+def test_adya_g2_checker():
+    T = independent.tuple_
+    good = [
+        {"type": "ok", "f": "insert", "value": T(0, [1, None])},
+        {"type": "fail", "f": "insert", "value": T(0, [None, 2])},
+        {"type": "ok", "f": "insert", "value": T(1, [3, None])},
+    ]
+    res = adya.g2_checker().check({}, good)
+    assert res["valid"] is True
+    assert res["key-count"] == 2
+
+    bad = good + [{"type": "ok", "f": "insert", "value": T(0, [None, 9])}]
+    res = adya.g2_checker().check({}, bad)
+    assert res["valid"] is False
+    assert 0 in res["illegal"]
+
+
+def test_adya_generator_pairs():
+    random.seed(45100)
+    g = adya.g2_gen()
+    test = {"nodes": ["n1"], "concurrency": 4}
+    hist = simulate(test, gen.limit(12, g), perfect)
+    invokes = [o for o in hist if o["type"] == "invoke"]
+    by_key = {}
+    ids = []
+    for o in invokes:
+        k, pair = o["value"][0], o["value"][1]
+        by_key.setdefault(k, []).append(pair)
+        ids.extend(x for x in pair if x is not None)
+    # ids globally unique, exactly one of a/b per op, two ops per key
+    assert len(set(ids)) == len(ids)
+    assert all(sum(x is not None for x in p) == 1
+               for ps in by_key.values() for p in ps)
+    assert all(len(ps) <= 2 for ps in by_key.values())

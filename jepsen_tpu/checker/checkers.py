@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import collections
 import concurrent.futures
+import logging
 import re
 import threading
 
@@ -18,6 +19,8 @@ from .. import history as h
 from ..models import base as mbase
 from ..util import nanos_to_secs
 from .core import Checker, compose, merge_valid
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "unhandled_exceptions", "stats", "linearizable", "queue", "set_checker",
@@ -110,6 +113,15 @@ class Linearizable(Checker):
         # truncate heavyweight fields (checker.clj:213-216)
         if "final_ops" in a:
             a["final_ops"] = a["final_ops"][:10]
+        if a.get("valid") is False:
+            # render the failure witness like the reference's linear.svg
+            # (checker.clj:206-212); never let plotting break the verdict
+            try:
+                from . import linear_report
+                linear_report.render_analysis(test, client_hist, a, opts)
+            except Exception:  # noqa: BLE001
+                logger.warning("couldn't render linear.png",
+                               exc_info=True)
         a["valid?"] = a["valid"]
         return a
 

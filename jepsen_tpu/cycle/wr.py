@@ -101,12 +101,9 @@ def analyze(history, opts=None) -> dict:
     if opts.get("sequential_keys"):
         # Each key is sequentially consistent: every process observes
         # versions of k in the (single) version order. A process that
-        # touched version a of k in an earlier op and version b in a
-        # later op therefore witnesses a < b.
-        by_key: dict = {}
-        for op in oks:
-            for k in ext_writes(_txn(op)):
-                by_key.setdefault(k, []).append(op)
+        # touched version a of k before version b — across its ops OR
+        # within one txn's mop order (read-then-write) — witnesses
+        # a < b.
         by_process: dict = {}
         for op in oks:
             by_process.setdefault(op.get("process"), []).append(op)
@@ -114,15 +111,18 @@ def analyze(history, opts=None) -> dict:
         for p, pops in by_process.items():
             last_seen: dict = {}
             for op in pops:
-                touched = dict(ext_reads(_txn(op)))
-                touched.update(ext_writes(_txn(op)))
-                for k, v in touched.items():
+                for mop in _txn(op):
+                    k, v = mop[1], mop[2]
                     if v is None:
                         continue
                     prev = last_seen.get(k)
                     if prev is not None and prev != v:
                         before[(k, prev, v)] = True
                     last_seen[k] = v
+        readers: dict = {}   # (k, v) -> ops that externally read v
+        for op in oks:
+            for k, v in ext_reads(_txn(op)).items():
+                readers.setdefault((k, v), []).append(op)
         for (k, va, vb) in before:
             a, b = writer.get((k, va)), writer.get((k, vb))
             if a is not None and b is not None and a is not b:
@@ -131,10 +131,8 @@ def analyze(history, opts=None) -> dict:
                           "(sequential-keys)")
             # anyone who read va anti-depends on vb's writer
             if b is not None:
-                for op in oks:
-                    if op is b:
-                        continue
-                    if ext_reads(_txn(op)).get(k) == va:
+                for op in readers.get((k, va), ()):
+                    if op is not b:
                         graph.add(idx[id(op)], idx[id(b)], RW,
                                   f"{k}: read {va}; {vb} written after "
                                   "(sequential-keys)")

@@ -35,7 +35,7 @@ def g2_gen():
                          {"type": "invoke", "f": "insert",
                           "value": [next_id(), None]})]
 
-    return independent.concurrent_generator(2, _count_from(0), fgen)
+    return independent.concurrent_generator(2, itertools.count(), fgen)
 
 
 class _G2Checker(Checker):
@@ -73,10 +73,3 @@ def g2_checker():
 
 def workload():
     return {"generator": g2_gen(), "checker": g2_checker()}
-
-
-def _count_from(start):
-    k = start
-    while True:
-        yield k
-        k += 1

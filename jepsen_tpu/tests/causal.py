@@ -7,7 +7,8 @@ Ops carry ``position`` (this op's place in the causal order) and
 
 from __future__ import annotations
 
-from .. import checker as cc
+import itertools
+
 from .. import generator as gen
 from .. import independent
 from ..checker.core import Checker
@@ -136,15 +137,8 @@ def test(opts):
                                       {"type": "info", "f": "stop"})),
                 gen.stagger(
                     1, independent.concurrent_generator(
-                        1, _count_from(0),
+                        1, itertools.count(),
                         lambda k: [gen.once(ri), gen.once(cw1),
                                    gen.once(r), gen.once(cw2),
                                    gen.once(r)])))),
     }
-
-
-def _count_from(start):
-    k = start
-    while True:
-        yield k
-        k += 1

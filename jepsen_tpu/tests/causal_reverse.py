@@ -7,6 +7,8 @@ shows causal reversal."""
 
 from __future__ import annotations
 
+import itertools
+
 from .. import checker as cc
 from .. import generator as gen
 from .. import independent
@@ -64,29 +66,23 @@ def workload(opts):
     nodes (worker count per key), per-key-limit (default 500)."""
     n = len(opts.get("nodes") or []) or 1
 
-    def writes():
-        v = 0
-        while True:
-            yield {"f": "write", "value": v}
-            v += 1
-
     def fgen(k):
+        counter = itertools.count()
+
+        def write(test, ctx):
+            return {"f": "write", "value": next(counter)}
+
+        def read(test, ctx):
+            return {"f": "read"}
+
         return gen.limit(
             opts.get("per-key-limit", 500),
-            gen.stagger(1 / 100, gen.mix([{"f": "read"},
-                                          writes()])))
+            gen.stagger(1 / 100, gen.mix([read, write])))
 
     return {
         "checker": cc.compose({
             "sequential": independent.checker(checker()),
         }),
         "generator": independent.concurrent_generator(
-            n, _count_from(0), fgen),
+            n, itertools.count(), fgen),
     }
-
-
-def _count_from(start):
-    k = start
-    while True:
-        yield k
-        k += 1

@@ -1,0 +1,245 @@
+"""fs-cache, faketime, charybdefs, and membership nemesis tests
+(reference test/jepsen/fs_cache_test.clj + the nemesis/membership and
+charybdefs recipes)."""
+
+import os
+import threading
+import time as wall
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu import faketime, fs_cache
+from jepsen_tpu.control.remotes import DummyRemote
+
+
+@pytest.fixture(autouse=True)
+def cache_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(fs_cache, "dir", str(tmp_path / "cache"))
+
+
+def dummy_test(nodes=("n1",)):
+    log = []
+    return {"nodes": list(nodes), "ssh": {"dummy?": True},
+            "dummy-log": log}
+
+
+# -- fs-cache ----------------------------------------------------------------
+
+def test_path_encoding_distinguishes_types_and_nesting():
+    assert fs_cache.fs_path(["foo"]) == ["fs_foo"]
+    assert fs_cache.fs_path(["foo", "bar"]) == ["ds_foo", "fs_bar"]
+    assert fs_cache.fs_path([1]) == ["fl_1"]
+    assert fs_cache.fs_path([True]) == ["fb_true"]
+    assert fs_cache.fs_path(["a/b"]) == ["fs_a\\/b"]
+    with pytest.raises(ValueError):
+        fs_cache.fs_path([])
+    with pytest.raises(TypeError):
+        fs_cache.fs_path("not-a-seq")
+
+
+def test_string_roundtrip_and_cached():
+    path = ["db", "license"]
+    assert not fs_cache.cached(path)
+    assert fs_cache.load_string(path) is None
+    assert fs_cache.save_string("sekrit", path) == "sekrit"
+    assert fs_cache.cached(path)
+    assert fs_cache.load_string(path) == "sekrit"
+    fs_cache.clear(path)
+    assert not fs_cache.cached(path)
+
+
+def test_data_roundtrip():
+    data = {"nodes": ["a", "b"], "epoch": 3}
+    fs_cache.save_data(data, ["cluster", "state"])
+    assert fs_cache.load_data(["cluster", "state"]) == data
+
+
+def test_file_roundtrip(tmp_path):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"\x00\x01binary")
+    fs_cache.save_file(str(src), ["blobs", 7])
+    f = fs_cache.load_file(["blobs", 7])
+    assert f is not None
+    with open(f, "rb") as fh:
+        assert fh.read() == b"\x00\x01binary"
+
+
+def test_clear_all():
+    fs_cache.save_string("x", ["one"])
+    fs_cache.save_string("y", ["two"])
+    fs_cache.clear()
+    assert not fs_cache.cached(["one"])
+    assert not fs_cache.cached(["two"])
+
+
+def test_locking_serializes():
+    order = []
+
+    def worker(i):
+        with fs_cache.locking(["expensive"]):
+            order.append(("in", i))
+            wall.sleep(0.05)
+            order.append(("out", i))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # no interleaving: every "in" is immediately followed by its "out"
+    for a, b in zip(order[::2], order[1::2]):
+        assert a[0] == "in" and b[0] == "out" and a[1] == b[1]
+
+
+def test_deploy_remote_guards_suspicious_paths():
+    fs_cache.save_string("x", ["d"])
+    with pytest.raises(ValueError, match="suspicious"):
+        fs_cache.deploy_remote(["d"], "/etc")
+    with pytest.raises(RuntimeError, match="not cached"):
+        fs_cache.deploy_remote(["nope"], "/var/lib/db/data")
+
+
+def test_deploy_remote_command_stream():
+    fs_cache.save_string("data", ["deployable"])
+    test = dummy_test()
+    with c.ssh_scope(test), c.on("n1"):
+        fs_cache.deploy_remote(["deployable"], "/var/lib/db/data")
+    cmds = [cmd for _, cmd in test["dummy-log"]]
+    assert any("rm -rf /var/lib/db/data" in x for x in cmds)
+    assert any("mkdir -p /var/lib/db" in x for x in cmds)
+    assert any(x.startswith("upload") for x in cmds)
+
+
+# -- faketime ----------------------------------------------------------------
+
+def test_faketime_script():
+    s = faketime.script("/usr/bin/db", 30, 1.5)
+    assert s.startswith("#!/bin/bash")
+    assert 'faketime -m -f "+30s x1.5"' in s
+    assert '/usr/bin/db "$@"' in s
+    assert '"-5s' in faketime.script("/x", -5, 1.0).replace("x1.0", "")
+
+
+def test_faketime_rand_factor():
+    import random
+    rng = random.Random(45100)
+    draws = [faketime.rand_factor(2.5, rng) for _ in range(500)]
+    assert max(draws) / min(draws) <= 2.5
+    assert all(0 < d < 2 for d in draws)
+
+
+class NoFileRemote(DummyRemote):
+    """test -e always fails: wrap sees no prior wrapper."""
+
+    def connect(self, conn_spec):
+        return NoFileRemote(conn_spec.get("host"), self.log)
+
+    def execute(self, ctx, action):
+        out = super().execute(ctx, action)
+        if "test -e" in out.get("cmd", ""):
+            out["exit"] = 1
+        return out
+
+
+def test_faketime_wrap_moves_original_once():
+    log = []
+    test = {"nodes": ["n1"], "remote": NoFileRemote(log=log),
+            "dummy-log": log}
+    with c.ssh_scope(test), c.on("n1"):
+        faketime.wrap("/usr/bin/db", 10, 1.2)
+    cmds = [cmd for _, cmd in log]
+    assert any("mv /usr/bin/db /usr/bin/db.no-faketime" in x for x in cmds)
+    assert any(x.startswith("upload") and "/usr/bin/db" in x for x in cmds)
+    assert any("chmod a+x /usr/bin/db" in x for x in cmds)
+
+
+# -- charybdefs --------------------------------------------------------------
+
+def test_charybdefs_cookbook_commands():
+    from jepsen_tpu import charybdefs
+    test = dummy_test()
+    with c.ssh_scope(test), c.on("n1"):
+        charybdefs.break_all()
+        charybdefs.break_one_percent()
+        charybdefs.clear()
+    cmds = [cmd for _, cmd in test["dummy-log"]]
+    assert any("--io-error" in x and "cookbook" in x for x in cmds)
+    assert any("--probability" in x for x in cmds)
+    assert any("--clear" in x for x in cmds)
+
+
+# -- membership nemesis ------------------------------------------------------
+
+def test_membership_package_lifecycle():
+    """A toy state machine: nodes join one by one; views poll via the
+    control plane; ops resolve once the view reflects them."""
+    from jepsen_tpu.nemesis import membership as m
+
+    class JoinState(m.State):
+        def __init__(self, joined=frozenset(), target=()):
+            self.joined = frozenset(joined)
+            self.target = tuple(target)
+
+        def node_view(self, test, node):
+            return sorted(self.joined)
+
+        def merge_views(self, test):
+            views = [v for v in self.node_views.values() if v is not None]
+            return sorted(set().union(*map(set, views))) if views else []
+
+        def fs(self):
+            return {"join"}
+
+        def op(self, test):
+            left = [n for n in self.target if n not in self.joined]
+            if not left:
+                return None
+            if self.pending:
+                return "pending"
+            return {"type": "info", "f": "join", "value": left[0]}
+
+        def invoke(self, test, op):
+            out = dict(op)
+            out["type"] = "info"
+            return out
+
+        def resolve_op(self, test, pair):
+            inv, done = pair
+            node = dict(inv).get("value")
+            if node not in self.joined:
+                return self.assoc(joined=self.joined | {node})
+            return None
+
+    test = dummy_test(["n1", "n2"])
+    test["concurrency"] = 1
+    pkg = m.package({"faults": {"membership"}, "interval": 0.01,
+                     "membership": {"state": JoinState(
+                         target=("n1", "n2")),
+                         "node_view_interval": 0.05}})
+    assert pkg is not None
+    nem = pkg["nemesis"]
+    with c.ssh_scope(test):
+        nem.setup(test)
+        # drive ops by hand: generator box shares nemesis state
+        from jepsen_tpu import generator as gen
+        ctx = gen.context(test)
+        seen = []
+        for _ in range(200):
+            got = gen.gen_op(pkg["generator"], test, ctx)
+            if got is None:
+                break
+            op, nxt = got
+            pkg = dict(pkg, generator=nxt)
+            if op is gen.PENDING or op == gen.PENDING:
+                wall.sleep(0.01)
+                continue
+            seen.append(nem.invoke(test, dict(op)))
+        nem.teardown(test)
+    assert [o["value"] for o in seen] == ["n1", "n2"]
+    assert nem.box["state"].joined == {"n1", "n2"}
+
+
+def test_membership_package_disabled():
+    from jepsen_tpu.nemesis import membership as m
+    assert m.package({"faults": {"kill"}}) is None

@@ -338,3 +338,16 @@ def test_wr_garbage_read_unknown():
     hist = H(("info", [["w", "x", 7]]),
              [["r", "x", 7]])
     assert wrx.analyze(hist)["valid"] is True
+
+
+def test_wr_sequential_keys_intra_txn_witness():
+    """[r x 1][w x 2] inside one txn witnesses 1 < 2 even though the
+    write overwrites the read's key."""
+    hist = H([["w", "x", 1]],
+             [["r", "x", 1], ["w", "x", 2]],
+             [["r", "x", 2]],
+             [["r", "x", 1]])
+    # p4... regroup: one process reads 2 then 1, contradicting 1 < 2
+    hist[2]["process"] = hist[3]["process"] = 9
+    res = wrx.analyze(hist, {"sequential_keys": True})
+    assert res["valid"] is False

@@ -209,3 +209,18 @@ def test_adya_generator_pairs():
     assert all(sum(x is not None for x in p) == 1
                for ps in by_key.values() for p in ps)
     assert all(len(ps) <= 2 for ps in by_key.values())
+
+
+def test_causal_reverse_generator_runs():
+    """The workload generator must mix reads and writes throughout (reads
+    are not one-shot) and run under simulation."""
+    random.seed(45100)
+    wl = causal_reverse.workload({"nodes": ["n1"], "per-key-limit": 40})
+    test = {"nodes": ["n1"], "concurrency": 1}
+    hist = simulate(test, gen.time_limit(2, wl["generator"]), perfect)
+    invokes = [o for o in hist if o["type"] == "invoke"]
+    assert len(invokes) > 10
+    fs = [o["f"] for o in invokes]
+    assert fs.count("read") >= 3 and fs.count("write") >= 3
+    # reads keep appearing after the first few ops
+    assert "read" in fs[len(fs) // 2:]

@@ -1,12 +1,18 @@
-"""Benchmark: ops verified/sec on CAS-register histories (BASELINE.json).
+"""Benchmark: the BASELINE.json config ladder for the device WGL engine.
 
-Measures the device WGL engine on the BASELINE config ladder's first two
-rungs: (1) single ~200-op cas-register histories, (2) a multi-key batch
-(jepsen.independent-style) checked in one vmapped program. The baseline is
-the sequential CPU oracle (our knossos stand-in, checker/wgl.py) on the
-same histories.
+Rungs (BASELINE.md north-star table):
+  1. single ~200-op cas-register histories     (CPU-parity baseline)
+  2. 32-key batched per-key checks, one chip   (jepsen.independent style)
+  3. mutex, high contention
+  4. FIFO queue (unbounded state under vmap)
+  5. 10k-op / 64-process cas-register with many info ops
+     (the stretch goal: decided on device where the CPU oracle gives up)
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The baseline is the sequential CPU WGL oracle (our knossos stand-in,
+checker/wgl.py) with a 60 s / config-capped budget per history.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with the
+headline from rung 2 (comparable across rounds) and per-rung detail.
 """
 
 import json
@@ -16,15 +22,65 @@ import time
 
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
+ORACLE_BUDGET_S = 60.0
+
+
+def _oracle_worker(spec_name, hist, q):
+    import sys as _s
+    _s.path.insert(0, __file__.rsplit("/", 1)[0])
+    from jepsen_tpu.checker import wgl
+    from jepsen_tpu.models import model_spec
+    spec = model_spec(spec_name)
+    e, st = spec.encode(hist)
+    t0 = time.monotonic()
+    r = wgl.check_encoded(spec, e, st, max_configs=50_000_000)
+    q.put({"valid": r["valid"], "s": time.monotonic() - t0})
+
+
+class OracleRace:
+    """CPU oracle in a killable subprocess (a timed-out thread would keep
+    burning CPU under the device benches)."""
+
+    def __init__(self, spec_name, hist):
+        import multiprocessing as mp
+        self.ctx = mp.get_context("spawn")
+        self.q = self.ctx.Queue()
+        self.p = self.ctx.Process(target=_oracle_worker,
+                                  args=(spec_name, hist, self.q),
+                                  daemon=True)
+        self.t0 = time.monotonic()
+        self.p.start()
+
+    def result(self, budget_s=ORACLE_BUDGET_S):
+        left = max(0.0, budget_s - (time.monotonic() - self.t0))
+        self.p.join(timeout=left)
+        out = {"valid": "unknown", "error": "timeout",
+               "s": min(budget_s, time.monotonic() - self.t0)}
+        try:
+            got = self.q.get_nowait()
+            out.update(got)
+            out.pop("error", None)
+        except Exception:  # noqa: BLE001 - empty queue = still running
+            pass
+        if self.p.is_alive():
+            self.p.terminate()
+        return out
+
 
 def main():
-    from jepsen_tpu.checker import wgl
-    from jepsen_tpu.models import cas_register_spec
+    from jepsen_tpu.checker import jax_wgl, wgl
+    from jepsen_tpu.models import (cas_register_spec, fifo_queue_spec,
+                                   mutex_spec)
     from jepsen_tpu.parallel import check_batch_encoded
     from jepsen_tpu.simulate import corrupt, random_history
 
-    spec = cas_register_spec
+    rungs = {}
     rng = random.Random(45100)
+
+    # -- rungs 1 + 2: cas-register, single + batched ---------------------
+    # (drawn FIRST from the seeded rng: the same histories as round 1's
+    # bench, so the headline rate is comparable across rounds)
+    spec = cas_register_spec
     n_keys, ops_per_key = 32, 200
     hists = []
     for k in range(n_keys):
@@ -33,24 +89,95 @@ def main():
         if k % 8 == 7:
             hist = corrupt(rng, hist)
         hists.append(hist)
+    hist3 = random_history(rng, "mutex", n_procs=16, n_ops=2000,
+                           crash_p=0.02)
+    hist4 = random_history(rng, "fifo-queue", n_procs=6, n_ops=150,
+                           crash_p=0.02)
+    hist5 = random_history(rng, "cas-register", n_procs=64, n_ops=10_000,
+                           crash_p=0.05)
     pairs = [spec.encode(hist) for hist in hists]
     total_ops = sum(len(e) for e, _ in pairs)
 
-    # CPU baseline: sequential WGL oracle over all keys
     t0 = time.monotonic()
-    base_results = [wgl.check_encoded(spec, e, st) for e, st in pairs]
+    base_results = [wgl.check_encoded(spec, e, st, max_configs=2_000_000)
+                    for e, st in pairs]
     cpu_s = time.monotonic() - t0
-    cpu_rate = total_ops / cpu_s
 
-    # Device: warm up with the identical shape bundle (compile), then measure
-    check_batch_encoded(spec, pairs)
+    # rung 1: one history at a time on device (warm, after compile)
+    e1, st1 = pairs[0]
+    jax_wgl.check_encoded(spec, e1, st1)
+    t0 = time.monotonic()
+    r1 = jax_wgl.check_encoded(spec, e1, st1)
+    rung1_s = time.monotonic() - t0
+    rungs["1-cas-single"] = {
+        "ops": len(e1), "device_s": round(rung1_s, 3),
+        "valid": r1["valid"],
+    }
+
+    # rung 2: the whole key batch in one device program
+    check_batch_encoded(spec, pairs)          # compile warmup
     t0 = time.monotonic()
     dev_results = check_batch_encoded(spec, pairs)
     dev_s = time.monotonic() - t0
-    dev_rate = total_ops / dev_s
-
     agree = sum(1 for a, b in zip(base_results, dev_results)
                 if a["valid"] == b["valid"])
+    dev_rate = total_ops / dev_s
+    cpu_rate = total_ops / cpu_s
+    rungs["2-cas-multikey"] = {
+        "keys": n_keys, "total_ops": total_ops,
+        "device_s": round(dev_s, 3), "cpu_oracle_s": round(cpu_s, 3),
+        "device_rate": round(dev_rate, 1),
+        "cpu_rate": round(cpu_rate, 1),
+        "verdicts_agree": f"{agree}/{n_keys}",
+    }
+
+    # -- rung 3: mutex, high contention ----------------------------------
+    e3, st3 = mutex_spec.encode(hist3)
+    t0 = time.monotonic()
+    r3 = jax_wgl.check_encoded(mutex_spec, e3, st3, timeout_s=60)
+    d3 = time.monotonic() - t0
+    rungs["3-mutex"] = {
+        "ops": len(e3), "procs": 16,
+        "device_s": round(d3, 1), "device_valid": r3["valid"],
+    }
+
+    # -- rung 4: FIFO queue ----------------------------------------------
+    e4, st4 = fifo_queue_spec.encode(hist4)
+    t0 = time.monotonic()
+    r4 = jax_wgl.check_encoded(fifo_queue_spec, e4, st4, timeout_s=60)
+    d4 = time.monotonic() - t0
+    rungs["4-fifo-queue"] = {
+        "ops": len(e4), "procs": 6,
+        "device_s": round(d4, 1), "device_valid": r4["valid"],
+    }
+
+    # -- rung 5: the stretch goal ----------------------------------------
+    e5, st5 = cas_register_spec.encode(hist5)
+    t0 = time.monotonic()
+    r5 = jax_wgl.check_encoded(cas_register_spec, e5, st5, timeout_s=120)
+    d5 = time.monotonic() - t0
+    rungs["5-cas-10k-64proc"] = {
+        "ops": len(e5), "procs": 64,
+        "infos": int((~e5.is_ok).sum()),
+        "device_s": round(d5, 1), "device_valid": r5["valid"],
+        "device_iterations": r5.get("iterations"),
+    }
+
+    # CPU oracles race in parallel subprocesses AFTER all device
+    # measurements (their CPU load would pollute the device numbers);
+    # total added wall time <= one 60 s budget
+    oracles = {"3": OracleRace("mutex", hist3),
+               "4": OracleRace("fifo-queue", hist4),
+               "5": OracleRace("cas-register", hist5)}
+    for key, rung in (("3", "3-mutex"), ("4", "4-fifo-queue"),
+                      ("5", "5-cas-10k-64proc")):
+        o = oracles[key].result()
+        rungs[rung]["cpu_s"] = round(o["s"], 1)
+        rungs[rung]["cpu_valid"] = o["valid"]
+    rungs["5-cas-10k-64proc"]["goal_met"] = bool(
+        r5["valid"] in (True, False) and d5 < 60
+        and rungs["5-cas-10k-64proc"]["cpu_valid"] == "unknown")
+
     if agree != n_keys:
         print(json.dumps({"metric": "ops verified/sec (cas-register)",
                           "value": 0.0, "unit": "ops/s",
@@ -63,13 +190,8 @@ def main():
         "value": round(dev_rate, 1),
         "unit": "ops/s",
         "vs_baseline": round(dev_rate / cpu_rate, 3),
-        "detail": {
-            "keys": n_keys, "ops_per_key": ops_per_key,
-            "total_ops": total_ops,
-            "device_s": round(dev_s, 3), "cpu_oracle_s": round(cpu_s, 3),
-            "cpu_oracle_rate": round(cpu_rate, 1),
-            "verdicts_agree": agree,
-        }}))
+        "detail": rungs,
+    }))
 
 
 if __name__ == "__main__":

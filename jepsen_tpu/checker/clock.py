@@ -76,7 +76,12 @@ class _ClockPlot(Checker):
     (checker.clj:831-837)."""
 
     def check(self, test, history, opts=None):
-        plot(test, history, opts)
+        try:
+            plot(test, history, opts)
+        except Exception:  # noqa: BLE001 - plotting must not affect the
+            import logging  # verdict (the checker's contract is valid)
+            logging.getLogger(__name__).warning(
+                "couldn't render clock-skew.png", exc_info=True)
         return {"valid": True, "valid?": True}
 
 

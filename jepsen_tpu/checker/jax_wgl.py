@@ -120,7 +120,7 @@ KEYED = (0, 1, 2, 5, 6, 7, 8, 9, 10, 11)
 
 
 @functools.lru_cache(maxsize=64)
-def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1):
+def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
     """Compile the search for one shape bundle with an explicit key-batch
     axis K (jepsen.independent keys, BASELINE config 2). Returns jitted
 
@@ -154,6 +154,14 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1):
     arange_K = np.arange(K, dtype=np.int32)
     M = W * C
     KM = K * M
+    if R is None:
+        # Greedy-rollout chain length per iteration. Each rollout step is
+        # a handful of tiny sequential device ops, so the chain only pays
+        # for itself on histories deep enough that advancing R levels per
+        # iteration beats plain branch-and-bound; short histories skip it.
+        R = 0 if n <= 256 else min(256, n)
+    ML = M + R
+    KML = K * ML
     Tc = 1 << 16   # twin-claim scratch; fixed so carries are W-independent
 
     step_one = lambda st, f, a, r: step_fn(st, f, a, r, jnp)  # noqa: E731
@@ -161,6 +169,9 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1):
     step_vvv = jax.vmap(jax.vmap(jax.vmap(
         step_one, in_axes=(None, 0, 0, 0)), in_axes=(0, 0, 0, 0)),
         in_axes=(0, 0, 0, 0))
+    # vmap over all n ops from one state, then keys (rollout)
+    step_vn = jax.vmap(jax.vmap(
+        step_one, in_axes=(None, 0, 0, 0)), in_axes=(0, 0, 0, 0))
 
     def fingerprint(words):
         """words: (KM, B+S+1) uint32 -> two (KM,) uint32 hashes.
@@ -187,11 +198,17 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1):
         running = (status == RUNNING) & (top > 0)             # (K,)
 
         # -- pop per-key frontiers ------------------------------------------
+        # The stack is a RING over O slots with an absolute top counter:
+        # overflow overwrites the OLDEST (shallowest) entries rather than
+        # dropping the newest. Deep rollout chains must always land --
+        # dropping them stalls the search at a plateau forever. Any
+        # overwrite forfeits exhaustion proofs only (dropped flag);
+        # popping a slot that was overwritten yields some other real
+        # config, which is sound to explore.
         start = jnp.where(running, jnp.maximum(top - W, 0), top)
         idx = start[:, None] + arange_W[None, :]              # (K,W)
         fvalid = (idx < top[:, None]) & running[:, None]
-        gidx = (arange_K[:, None] * O + jnp.minimum(idx, O - 1)).reshape(KM
-                 // C)
+        gidx = (arange_K[:, None] * O + idx % O).reshape(KM // C)
         lin = jnp.take(buf_lin.reshape(K * O, B), gidx,
                        axis=0).reshape(K, W, B)
         state = jnp.take(buf_state.reshape(K * O, S), gidx,
@@ -205,11 +222,30 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1):
         cand = unlin & (invoke[:, None, :] < rmin[..., None]) \
             & fvalid[..., None]
         # First C candidate positions per row without top_k (which lowers
-        # to per-row sorts on TPU): rank by prefix sum, reduce a one-hot.
+        # to per-row sorts on TPU). Ops arrive ALREADY RENUMBERED into
+        # linearization-priority order (host-side argsort by the model
+        # hint / earliest deadline, see _priority_order), so "first C by
+        # index" IS "best C by priority" -- the kernel stays all-static
+        # index math with no per-iteration gathers.
         rank = jnp.cumsum(cand.astype(jnp.int32), axis=2)     # (K,W,n)
-        onehot = (rank[..., None] == (arange_C[None, None, None, :] + 1)) \
-            & cand[..., None]                                 # (K,W,n,C)
-        ci = jnp.sum(onehot * arange_n[None, None, :, None], axis=2)
+        if n * C <= 32768:
+            # small problems: a dense one-hot reduction beats a dynamic
+            # scatter (TPU scatters have high fixed cost)
+            onehot = (rank[..., None]
+                      == (arange_C[None, None, None, :] + 1)) \
+                & cand[..., None]                             # (K,W,n,C)
+            ci = jnp.sum(
+                onehot * arange_n[None, None, :, None],
+                axis=2).astype(jnp.int32)
+        else:
+            tgt = jnp.where(cand & (rank <= C), rank - 1, C)
+            row = jnp.broadcast_to(
+                (arange_K[:, None] * W + arange_W[None, :])[..., None],
+                (K, W, n))
+            ops_b = jnp.broadcast_to(arange_n[None, None, :], (K, W, n))
+            ci = jnp.zeros((K * W, C), jnp.int32) \
+                .at[row.reshape(-1), tgt.reshape(-1)] \
+                .set(ops_b.reshape(-1), mode="drop").reshape(K, W, C)
         cvalid = arange_C[None, None, :] < rank[..., -1:]     # (K,W,C)
 
         # -- model step over (key, frontier, candidate) ---------------------
@@ -255,14 +291,105 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1):
             jnp.take_along_axis(st2k, bi[:, None, None], axis=1)[:, 0],
             best_state)
 
+        # -- greedy rollout -------------------------------------------------
+        # Branch-and-bound advances depth at most 1 per iteration, and
+        # iterations are latency-bound (~ms), so a 10k-op history would
+        # need 10k dispapched iterations. Instead, from the deepest fresh
+        # child, follow the greedy chain -- always linearize the eligible
+        # op with the EARLIEST DEADLINE whose model step succeeds -- for
+        # up to R steps inside this same kernel (one lax.scan; per-step
+        # work is O(K*n), trivial). On valid histories the chain usually
+        # just walks the witness, advancing depth R per iteration; the
+        # chain configs are pushed (deepest on top) and deduped like any
+        # others, so backtracking still explores alternatives around any
+        # step the greedy choice got wrong.
+        seed_ok = running & (bd >= 0)
+        seed_lin = jnp.take_along_axis(lin2k, bi[:, None, None],
+                                       axis=1)[:, 0]          # (K,B)
+        seed_st = jnp.take_along_axis(st2k, bi[:, None, None],
+                                      axis=1)[:, 0]           # (K,S)
+
+        def roll_step(rc_, _):
+            lin_r, st_r, alive = rc_
+            wb = jnp.take(lin_r, word_idx, axis=1)            # (K,n)
+            unl = ((wb >> bit_idx[None, :]) & jnp.uint32(1)) == 0
+            rm = jnp.min(jnp.where(unl, ret, INF32), axis=1)  # (K,)
+            elig = unl & (invoke < rm[:, None])
+            stn, okn = step_vn(st_r, fop, args, rets)         # (K,n,S)
+            succ = elig & okn & alive[:, None]
+            # first succeeding op in index order = best priority (ops are
+            # pre-sorted by the linearization hint)
+            j = jnp.argmax(succ, axis=1).astype(jnp.int32)    # (K,)
+            took = succ.any(axis=1)
+            wsel = jnp.take(word_idx, j)
+            bmask = (arange_B[None, :]
+                     == wsel[:, None].astype(jnp.uint32))
+            newlin = lin_r | jnp.where(
+                bmask & took[:, None],
+                jnp.uint32(1) << jnp.take(bit_idx, j)[:, None],
+                jnp.uint32(0))
+            newst = jnp.where(
+                took[:, None],
+                jnp.take_along_axis(stn, j[:, None, None], axis=1)[:, 0]
+                .astype(jnp.int32), st_r)
+            alive = alive & took
+            return (newlin, newst, alive), (newlin, newst, alive)
+
+        if R:
+            _, (ch_lin, ch_st, ch_alive) = lax.scan(
+                roll_step, (seed_lin, seed_st, seed_ok), None, length=R)
+            ch_lin = jnp.moveaxis(ch_lin, 0, 1)               # (K,R,B)
+            ch_st = jnp.moveaxis(ch_st, 0, 1)                 # (K,R,S)
+            ch_alive = jnp.moveaxis(ch_alive, 0, 1)           # (K,R)
+
+            okw2 = ok_words[:, None, :]
+            ch_done = jnp.all((ch_lin & okw2) == okw2, axis=-1) & ch_alive
+            status = jnp.where(running & ch_done.any(axis=1), VALID,
+                               status)
+            ch_depth = jnp.where(
+                ch_alive,
+                lax.population_count(ch_lin & okw2).sum(-1)
+                .astype(jnp.int32),
+                -1)                                           # (K,R)
+            cbi = jnp.argmax(ch_depth, axis=1)
+            cbd = jnp.take_along_axis(ch_depth, cbi[:, None],
+                                      axis=1)[:, 0]
+            cbetter = cbd > best_depth
+            best_depth = jnp.where(cbetter, cbd, best_depth)
+            best_lin = jnp.where(
+                cbetter[:, None],
+                jnp.take_along_axis(ch_lin, cbi[:, None, None],
+                                    axis=1)[:, 0],
+                best_lin)
+            best_state = jnp.where(
+                cbetter[:, None],
+                jnp.take_along_axis(ch_st, cbi[:, None, None],
+                                    axis=1)[:, 0],
+                best_state)
+
+        # -- combined lane order (expansion then chain) ---------------------
+        # Later lanes land higher on the stack, so order lanes as:
+        # expansion in (w asc, c desc) -- putting the deepest parent's
+        # earliest-deadline child last among expansions -- then the chain
+        # ascending, so the chain's deepest config tops the stack.
+        exp_lin = jnp.flip(lin2, axis=2).reshape(K, M, B)
+        exp_st = jnp.flip(st2, axis=2).reshape(K, M, S)
+        exp_val = jnp.flip(child_valid, axis=2).reshape(K, M)
+        if R:
+            all_lin = jnp.concatenate([exp_lin, ch_lin], axis=1)
+            all_st = jnp.concatenate([exp_st, ch_st], axis=1)
+            all_val = jnp.concatenate([exp_val, ch_alive], axis=1)
+        else:
+            all_lin, all_st, all_val = exp_lin, exp_st, exp_val
+
         # -- fingerprints (key-salted: all keys share the tables) -----------
-        lin2f = lin2.reshape(KM, B)
-        st2f = st2.reshape(KM, S)
-        saltw = jnp.broadcast_to(salt[:, None], (K, M)).reshape(KM)
+        lin2f = all_lin.reshape(KML, B)
+        st2f = all_st.reshape(KML, S)
+        saltw = jnp.broadcast_to(salt[:, None], (K, ML)).reshape(KML)
         words = jnp.concatenate(
             [lin2f, st2f.astype(jnp.uint32), saltw[:, None]], axis=1)
         h1, h2 = fingerprint(words)
-        cv = child_valid.reshape(KM)
+        cv = all_val.reshape(KML)
 
         # In-batch twin dedup: parents in the same frontier often generate
         # identical children (diamond orders); left unchecked each copy is
@@ -273,7 +400,7 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1):
         # fingerprint collisions just mean both survive (extra work only).
         # Stale claims are unreadable: a slot is only read by lanes that
         # wrote it this iteration.
-        lane = jnp.arange(KM, dtype=jnp.int32)
+        lane = jnp.arange(KML, dtype=jnp.int32)
         cslot = jnp.where(cv, (h1 & jnp.uint32(Tc - 1)).astype(jnp.int32),
                           Tc)
         claim = claim.at[cslot].set(lane, mode="drop")
@@ -303,18 +430,25 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1):
         tab2 = tab2.at[wslot].set(h2, mode="drop")
 
         # -- push fresh configs (per-key positions, one flat scatter) -------
-        fresh = (cv & ~dup & ~seen).reshape(K, M)
+        # Lanes are already in push order (see combined lane order above):
+        # ascending positions put the last fresh lane -- the chain's
+        # deepest config -- on top of the stack for the next pop.
+        fresh = (cv & ~dup & ~seen).reshape(K, ML)
         offs = jnp.cumsum(fresh.astype(jnp.int32), axis=1) - 1
         cnt = offs[:, -1] + 1                                  # (K,)
-        pos = jnp.where(fresh, top[:, None] + offs, O)
+        pos = top[:, None] + offs
         dropped = dropped | (running & (top + cnt > O))
-        fpos = jnp.where(pos < O, arange_K[:, None] * O + pos,
-                         K * O).reshape(KM)
+        fpos = jnp.where(fresh, arange_K[:, None] * O + pos % O,
+                         K * O).reshape(KML)
         buf_lin = buf_lin.reshape(K * O, B).at[fpos] \
             .set(lin2f, mode="drop").reshape(K, O, B)
         buf_state = buf_state.reshape(K * O, S).at[fpos] \
             .set(st2f, mode="drop").reshape(K, O, S)
-        top = jnp.minimum(top + cnt, O)
+        # renormalize so the absolute counter can't overflow int32 over
+        # long runs: shifting by O preserves every slot index mod O, and
+        # `dropped` has already latched once a wrap occurred
+        top = top + cnt
+        top = jnp.where(top >= 2 * O, top - O, top)
 
         explored = explored + jnp.where(running,
                                         fvalid.sum(axis=1,
@@ -342,7 +476,10 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1):
         """Advance the search until every key succeeds/exhausts or the
         iteration counter reaches ``bound``. Bounded dispatches keep device
         kernels short (long single while_loops can trip runtime watchdogs)
-        and let the host enforce wall-clock budgets between chunks."""
+        and let the host enforce wall-clock budgets between chunks.
+
+        Op arrays must be pre-sorted into linearization-priority order
+        (_priority_order): index order IS search order."""
         consts = (invoke, ret, fop, args, rets, ok_words, salt, bound)
 
         def cond(c):
@@ -399,6 +536,28 @@ def _encode_arrays(e):
     return inv32, ret32, ok_words
 
 
+def _priority_order(spec, e, inv32, ret32):
+    """Renumber ops into linearization-priority order: argsort by the
+    model hint (default: earliest deadline / return index). The kernel
+    then searches candidates in plain index order with zero per-iteration
+    gather cost. Returns (perm, inv32, ret32, fop, args, rets, ok_words)
+    all permuted; witnesses decode back through perm."""
+    n = len(e)
+    pri = (np.asarray(spec.hint(e, inv32, ret32), np.int64)
+           if spec.hint is not None else ret32.astype(np.int64))
+    perm = np.argsort(pri, kind="stable").astype(np.int64)
+    inv_s = inv32[perm]
+    ret_s = ret32[perm]
+    fop = np.asarray(e.f, np.int32)[perm]
+    args = np.asarray(e.args, np.int32).reshape(n, -1)[perm]
+    rets = np.asarray(e.ret, np.int32).reshape(n, -1)[perm]
+    ok_s = np.asarray(e.is_ok, bool)[perm]
+    ok_words = np.zeros(max(1, (n + 31) // 32), np.uint32)
+    for i in np.flatnonzero(ok_s):
+        ok_words[i // 32] |= np.uint32(1) << np.uint32(i % 32)
+    return perm, inv_s, ret_s, fop, args, rets, ok_words
+
+
 def check_encoded(spec, e, init_state, max_configs=50_000_000,
                   frontier_width=None, stack_size=None, table_size=None,
                   confirm=False, timeout_s=None, chunk_iters=256):
@@ -411,18 +570,18 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     if n == 0 or e.n_ok == 0:
         return {"valid": True, "configs_explored": 0}
 
-    inv32, ret32, ok_words = _encode_arrays(e)
+    inv32, ret32, _ = _encode_arrays(e)
     C = max_point_concurrency(inv32, np.where(ret32 == INF32,
                                               INF_TIME, ret32.astype(np.int64)))
     A = int(e.args.shape[1]) if e.args.ndim == 2 else 1
+    perm, inv32, ret32, fop, args, rets, ok_words = _priority_order(
+        spec, e, inv32, ret32)
 
     # Pad shapes to power-of-two buckets so the compiled search is reused.
     # Padding rows are never candidates: they "invoke" after every finite
     # return (invoke INF32-1 >= any reachable r_min) and are not ok ops.
     n_pad = _bucket(n, 64)
     C = min(_bucket(C, 4), n_pad)
-    fop, args, rets = (np.asarray(e.f, np.int32), np.asarray(e.args, np.int32),
-                       np.asarray(e.ret, np.int32))
     if n_pad > n:
         pn = n_pad - n
         inv32 = np.concatenate([inv32, np.full(pn, INF32 - 1, np.int32)])
@@ -475,10 +634,10 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
         return {"valid": "unknown", "error": "timeout",
                 "configs_explored": int(out["explored"]),
                 "iterations": int(out["iterations"]), "engine": "jax-wgl"}
-    return _interpret(spec, e, out, max_iters, confirm, init_state)
+    return _interpret(spec, e, out, max_iters, confirm, init_state, perm)
 
 
-def _interpret(spec, e, out, max_iters, confirm, init_state):
+def _interpret(spec, e, out, max_iters, confirm, init_state, perm=None):
     status = int(out["status"])
     explored = int(out["explored"])
     result = {"configs_explored": explored,
@@ -491,7 +650,7 @@ def _interpret(spec, e, out, max_iters, confirm, init_state):
     dropped = bool(out["dropped"])
     if exhausted and not dropped:
         result["valid"] = False
-        _attach_witness(result, e, out)
+        _attach_witness(result, e, out, perm)
         if confirm:
             from . import wgl
             oracle = wgl.check_encoded(spec, e, init_state)
@@ -504,14 +663,16 @@ def _interpret(spec, e, out, max_iters, confirm, init_state):
     return result
 
 
-def _attach_witness(result, e, out):
+def _attach_witness(result, e, out, perm=None):
     """Decode the deepest stuck configuration into reference-style
-    :op / :final-paths info."""
+    :op / :final-paths info. Bit positions are in priority-sorted space;
+    perm maps them back to original op indices."""
     lin = np.asarray(out["best_lin"], np.uint32)
     n = len(e)
     linearized = np.zeros(n, bool)
     for i in range(n):
-        linearized[i] = bool((lin[i // 32] >> np.uint32(i % 32)) & 1)
+        pos = int(perm[i]) if perm is not None else i
+        linearized[pos] = bool((lin[i // 32] >> np.uint32(i % 32)) & 1)
     stuck = [i for i in range(n) if e.is_ok[i] and not linearized[i]]
     if stuck:
         i = stuck[0]

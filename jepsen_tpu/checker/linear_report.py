@@ -71,6 +71,8 @@ def render_analysis(test, history, analysis, opts=None):
         return (1, min(abs(t0 - w1), abs(w0 - t1)))
 
     chosen = sorted(pairs, key=sort_key)[:WINDOW]
+    if wpair not in chosen:      # never truncate away the witness itself
+        chosen[-1] = wpair
     chosen.sort(key=lambda p: interval(*p)[0])
 
     path = _out_path(test, opts or {}, "linear.png")

@@ -160,7 +160,7 @@ def deploy_remote(cache_path, remote_path: str):
     if not cached(cache_path):
         raise RuntimeError(
             f"path {cache_path!r} is not cached and cannot be deployed")
-    if not re.search(r"/\w+/.+", remote_path):
+    if not re.fullmatch(r"/\w+/.+", remote_path):
         raise ValueError(
             f"remote path {remote_path!r} looks relative or suspiciously "
             "short -- this might be dangerous!")

@@ -115,6 +115,13 @@ class ModelSpec:
     # canonicalization so the checker's dedup still sees equal states as
     # byte-equal. None = state size is fixed, never padded.
     pad_state: Callable = None
+    # optional fn(e, invoke32, ret32) -> int32[n] linearization priority
+    # for the device search (lower = try earlier). Purely a heuristic --
+    # soundness never depends on it. None = earliest-deadline-first
+    # (order by return index). Queues use this to order enqueues by
+    # their values' dequeue order (an enqueue must linearize before the
+    # dequeue that returns its value).
+    hint: Callable = None
 
     def encode(self, hist):
         """Encode an event history for this model. Returns (EncodedHistory,

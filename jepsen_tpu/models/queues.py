@@ -128,6 +128,31 @@ def _queue_encode(spec, intern, f, value, ret_value):
     raise ValueError(f"queue: unknown f {f!r}")
 
 
+def _fifo_hint(e, inv32, ret32):
+    """Search priority: an enqueue must linearize before the dequeue
+    returning its value, so cap each enqueue's priority at its dequeuer's
+    deadline. This orders enqueues by dequeue order -- without it, a
+    greedy enqueue-order mistake only manifests hundreds of ops later at
+    the dequeue, far beyond DFS backtracking range."""
+    pri = ret32.astype(np.int64)
+    enq_idx = {}
+    for i in range(len(e)):
+        if int(e.f[i]) == F_ENQUEUE:
+            enq_idx[int(e.args[i][0])] = i
+    for i in range(len(e)):
+        if int(e.f[i]) == F_DEQUEUE and bool(e.is_ok[i]):
+            j = enq_idx.get(int(e.ret[i][0]))
+            if j is not None:
+                # NOT min(own return, ...): an enqueue that completes
+                # early but whose value is dequeued late must still sort
+                # by its dequeuer, or concurrent enqueues linearize in
+                # completion order instead of pop order. The WGL
+                # eligibility rule (not priority) is what guarantees the
+                # enqueue still linearizes before its return barrier.
+                pri[j] = pri[i] - 1
+    return np.clip(pri, -(2 ** 31), 2 ** 31 - 1).astype(np.int32)
+
+
 fifo_queue_spec = register_model(ModelSpec(
     name="fifo-queue",
     f_codes={"enqueue": F_ENQUEUE, "dequeue": F_DEQUEUE},
@@ -139,6 +164,7 @@ fifo_queue_spec = register_model(ModelSpec(
     make_oracle=FIFOQueue,
     encode_op=_queue_encode,
     pad_state=_pad_nil,
+    hint=_fifo_hint,
 ))
 
 

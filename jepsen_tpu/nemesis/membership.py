@@ -116,6 +116,7 @@ class Nemesis(NemesisProto):
         self.box = box                 # {"state": State}
         self.opts = opts or {}
         self._running = threading.Event()
+        self._stop = threading.Event()
         self._threads = []
         self._lock = threading.Lock()
 
@@ -151,15 +152,14 @@ class Nemesis(NemesisProto):
             except Exception:  # noqa: BLE001 - keep polling
                 logger.warning("Node view updater caught error; will "
                                "retry", exc_info=True)
-            self._running.wait(0)   # fast exit check
-            for _ in range(int(interval * 10)):
-                if not self._running.is_set():
-                    return
-                threading.Event().wait(0.1)
+            # interruptible sleep: wakes immediately on teardown
+            if self._stop.wait(interval):
+                return
 
     def setup(self, test):
         self._swap(initial_fields)
         self._running.set()
+        self._stop.clear()
         ctx = contextvars.copy_context()
         for node in test.get("nodes", []):
             t = threading.Thread(
@@ -179,6 +179,7 @@ class Nemesis(NemesisProto):
 
     def teardown(self, test):
         self._running.clear()
+        self._stop.set()
         for t in self._threads:
             t.join(timeout=2)
 

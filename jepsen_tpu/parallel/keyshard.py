@@ -37,12 +37,13 @@ from ..history import INF_TIME
 
 
 def _pad_key(e, init_state, spec, n_pad, S_pad, A):
-    """Pad one key's encoded arrays to the common bucket sizes."""
+    """Priority-sort one key's encoded arrays (see
+    jax_wgl._priority_order) and pad to the common bucket sizes. Returns
+    the padded columns plus the priority perm for witness decoding."""
     n = len(e)
-    inv32, ret32, ok_words = _encode_arrays(e)
-    fop = np.asarray(e.f, np.int32)
-    args = np.asarray(e.args, np.int32).reshape(n, -1)
-    rets = np.asarray(e.ret, np.int32).reshape(n, -1)
+    inv32, ret32, _ = _encode_arrays(e)
+    perm, inv32, ret32, fop, args, rets, ok_words = \
+        jax_wgl._priority_order(spec, e, inv32, ret32)
     pn = n_pad - n
     inv32 = np.concatenate([inv32, np.full(pn, INF32 - 1, np.int32)])
     ret32 = np.concatenate([ret32, np.full(pn, INF32, np.int32)])
@@ -58,7 +59,7 @@ def _pad_key(e, init_state, spec, n_pad, S_pad, A):
         else:
             raise ValueError(
                 f"model {spec.name} has varying state sizes but no pad_state")
-    return inv32, ret32, fop, args, rets, ok_words, st
+    return inv32, ret32, fop, args, rets, ok_words, st, perm
 
 
 def _dummy_key(n_pad, S_pad, A):
@@ -69,7 +70,8 @@ def _dummy_key(n_pad, S_pad, A):
             np.zeros((n_pad, A), np.int32),
             np.zeros((n_pad, A), np.int32),
             np.zeros((n_pad + 31) // 32, np.uint32),
-            np.zeros(S_pad, np.int32))
+            np.zeros(S_pad, np.int32),
+            None)
 
 
 def _shard_specs(mesh, n_carry=14, n_consts=8):
@@ -149,6 +151,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     while len(cols) < K:
         cols.append(_dummy_key(n_pad, S_pad, A))
         salts.append(np.uint32(0))
+    perms = [c[7] for c in cols]          # host-only: witness decoding
     consts = tuple(jnp.asarray(np.stack([c[i] for c in cols]))
                    for i in range(7)) + (jnp.asarray(np.asarray(salts)),)
     init_states = consts[6]
@@ -223,11 +226,13 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         # shrinks, widen the per-key frontier to keep the chip busy --
         # carries are W-independent, so the wider kernel picks up the
         # straggler's stack and dedup table as-is.
-        if mesh is None and len(alive) > 1 and n_run <= len(alive) // 2:
+        if len(alive) > G and n_run <= len(alive) // 2:
             done_rows = [r for r in range(len(alive)) if not running[r]]
             harvest(done_rows, carry)
             keep = [r for r in range(len(alive)) if running[r]]
             newK = _bucket(n_run, 1)
+            while newK % G:            # keep a whole number of keys per
+                newK += 1              # device under a mesh
             pad_row = done_rows[0]
             idx = keep + [pad_row] * (newK - n_run)
             sel = jnp.asarray(np.asarray(idx, np.int32))
@@ -235,9 +240,35 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
                           for i, c in enumerate(carry))
             consts = tuple(jnp.take(c, sel, axis=0) for c in consts)
             alive = [alive[r] for r in keep] + [-1] * (newK - n_run)
-            W_wide = max(W, min(2048, 4096 // newK))
-            _, run_b = _build_search(spec.step, newK, n_pad, B, S_pad, C,
-                                     A, W_wide, O, T, G)
+            # budget lanes per DEVICE: each shard runs newK // G keys
+            W_wide = max(W, min(2048, 4096 // max(1, newK // G)))
+            if mesh is None:
+                _, run_b = _build_search(spec.step, newK, n_pad, B, S_pad,
+                                         C, A, W_wide, O, T, G)
+            else:
+                # keys reshard over the mesh; a moved key misses its old
+                # device's dedup entries (key-salted, so only a perf
+                # cost, never a correctness one)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                try:
+                    from jax import shard_map
+                except ImportError:  # older jax
+                    from jax.experimental.shard_map import shard_map
+                ax = mesh.axis_names[0]
+                carry_specs, const_specs = _shard_specs(mesh)
+                _, run_local = _build_search(
+                    spec.step, newK // G, n_pad, B, S_pad, C, A, W_wide,
+                    O, T, 1)
+                run_b = jax.jit(shard_map(
+                    run_local.__wrapped__, mesh=mesh,
+                    in_specs=(carry_specs,) + const_specs,
+                    out_specs=carry_specs, check_vma=False),
+                    donate_argnums=(0,))
+                keyed_sh = NamedSharding(mesh, P(ax))
+                carry = tuple(jax.device_put(x, keyed_sh) if i in KEYED
+                              else x for i, x in enumerate(carry))
+                consts = tuple(jax.device_put(x, keyed_sh)
+                               for x in consts)
 
     for j, k in enumerate(live):
         per = harvested[j]
@@ -248,7 +279,8 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
                           "engine": "jax-wgl"}
         else:
             results[k] = jax_wgl._interpret(spec, pairs[k][0], per,
-                                            max_iters, False, pairs[k][1])
+                                            max_iters, False, pairs[k][1],
+                                            perms[j])
     return results
 
 

@@ -54,3 +54,25 @@ def test_batch_sharded_over_mesh():
     for k, hist in enumerate(hists):
         expect = wgl.check_history(spec, hist)
         assert got[k]["valid"] == expect["valid"], f"key {k}"
+
+
+def test_batch_mesh_compaction_with_straggler():
+    """Fast keys harvest + compact while a deep straggler keeps running,
+    with keys resharding over the mesh (keyshard compaction previously
+    disabled under a mesh)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    from jax.sharding import Mesh
+    import numpy as np
+    spec = models.cas_register_spec
+    rng = random.Random(45100)
+    hists = [_random_history(rng, "cas-register", n_procs=3, n_ops=8)
+             for _ in range(15)]
+    # one hard straggler: long, crashy history -> deep search
+    hists.append(_random_history(rng, "cas-register", n_procs=6,
+                                 n_ops=120, crash_p=0.3))
+    mesh = Mesh(np.array(jax.devices()), ("keys",))
+    got = check_batch_histories(spec, hists, mesh=mesh, chunk_iters=16)
+    for k, hist in enumerate(hists):
+        expect = wgl.check_history(spec, hist)
+        assert got[k]["valid"] == expect["valid"], f"key {k}"

@@ -560,12 +560,22 @@ def _priority_order(spec, e, inv32, ret32):
 
 def check_encoded(spec, e, init_state, max_configs=50_000_000,
                   frontier_width=None, stack_size=None, table_size=None,
-                  confirm=False, timeout_s=None, chunk_iters=256):
+                  confirm=False, timeout_s=None, chunk_iters=256,
+                  checkpoint=None, checkpoint_every_s=60.0):
     """Device WGL search over an EncodedHistory. Result dict mirrors
     wgl.check_encoded: {"valid": True|False|"unknown", "configs_explored",
     ...}, plus device budget diagnostics. ``timeout_s`` bounds wall clock
     (checked between device chunks of ``chunk_iters`` iterations);
-    exceeding it yields {"valid": "unknown", "error": "timeout"}."""
+    exceeding it yields {"valid": "unknown", "error": "timeout"}.
+
+    ``checkpoint`` names a file the search frontier is periodically
+    snapshotted to (every ``checkpoint_every_s``, between chunks) — the
+    checkpoint/resume capability for long checks (SURVEY.md §5; the
+    reference has nothing comparable, its unit of durability is a whole
+    phase). A timed-out/killed check rerun with the same arguments
+    resumes from the snapshot instead of restarting; snapshots carry a
+    fingerprint of the search inputs so a stale file for a different
+    history or plan is ignored."""
     n = len(e)
     if n == 0 or e.n_ok == 0:
         return {"valid": True, "configs_explored": 0}
@@ -611,9 +621,21 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
               jnp.zeros(1, jnp.uint32))
     carry = init_carry(jnp.asarray(init_state[None]))
     import time as _time
+    fingerprint = None
+    if checkpoint is not None:
+        import hashlib
+        h = hashlib.sha256()
+        for a in (inv32, ret32, fop, args, rets, ok_words,
+                  np.asarray([n_pad, B, S, C, W, O, T], np.int64)):
+            h.update(np.ascontiguousarray(a).tobytes())
+        fingerprint = h.hexdigest()
+        resumed = _load_checkpoint(checkpoint, fingerprint)
+        if resumed is not None:
+            carry = tuple(jnp.asarray(x) for x in resumed)
     t0 = _time.monotonic()
+    last_ckpt = t0
     timed_out = False
-    it = 0
+    it = int(carry[12][0])
     while True:
         bound = min(it + chunk_iters, max_iters)
         carry = run_chunk(carry, *consts, jnp.int32(bound))
@@ -621,8 +643,15 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
                            int(carry[12][0]))
         if status != RUNNING or top == 0 or it >= max_iters:
             break
-        if timeout_s is not None and _time.monotonic() - t0 > timeout_s:
+        now = _time.monotonic()
+        if checkpoint is not None and \
+                now - last_ckpt >= checkpoint_every_s:
+            _save_checkpoint(checkpoint, fingerprint, carry)
+            last_ckpt = now
+        if timeout_s is not None and now - t0 > timeout_s:
             timed_out = True
+            if checkpoint is not None:
+                _save_checkpoint(checkpoint, fingerprint, carry)
             break
 
     out = {"status": carry[6][0], "top": carry[2][0],
@@ -633,8 +662,55 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     if timed_out and int(out["status"]) == RUNNING and int(out["top"]) > 0:
         return {"valid": "unknown", "error": "timeout",
                 "configs_explored": int(out["explored"]),
-                "iterations": int(out["iterations"]), "engine": "jax-wgl"}
-    return _interpret(spec, e, out, max_iters, confirm, init_state, perm)
+                "iterations": int(out["iterations"]), "engine": "jax-wgl",
+                **({"checkpoint": checkpoint} if checkpoint else {})}
+    result = _interpret(spec, e, out, max_iters, confirm, init_state,
+                        perm)
+    if checkpoint is not None:
+        if result.get("valid") in (True, False):
+            # decided: the snapshot is spent
+            import contextlib as _ctx
+            import os as _os
+            with _ctx.suppress(FileNotFoundError):
+                _os.unlink(checkpoint)
+        else:
+            # undecided (budget/overflow): keep a fresh snapshot so a
+            # rerun with a larger budget resumes instead of restarting
+            _save_checkpoint(checkpoint, fingerprint, carry)
+            result["checkpoint"] = checkpoint
+    return result
+
+
+def _save_checkpoint(path, fingerprint, carry):
+    """Atomically snapshot the search carry (stack, tables, witness
+    trackers, counters) with the input fingerprint."""
+    import os as _os
+    host = [np.asarray(x) for x in jax.device_get(carry)]
+    tmp = f"{path}.tmp"     # np.savez appends .npz to names without it
+    _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
+    np.savez_compressed(
+        tmp,
+        fingerprint=np.frombuffer(
+            fingerprint.encode(), dtype=np.uint8),
+        **{f"c{i}": x for i, x in enumerate(host)})
+    _os.replace(f"{tmp}.npz", path)
+
+
+def _load_checkpoint(path, fingerprint):
+    """Load a snapshot if it exists and matches the fingerprint; returns
+    the carry arrays or None."""
+    import os as _os
+    if not _os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as data:
+            got = bytes(data["fingerprint"]).decode()
+            if got != fingerprint:
+                return None
+            return [data[f"c{i}"]
+                    for i in range(len(data.files) - 1)]
+    except Exception:  # noqa: BLE001 - corrupt snapshot = start fresh
+        return None
 
 
 def _interpret(spec, e, out, max_iters, confirm, init_state, perm=None):

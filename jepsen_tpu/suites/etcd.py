@@ -17,7 +17,6 @@ the dummy remote, like the reference's integration tests.)"""
 from __future__ import annotations
 
 import json
-import random
 import threading
 import urllib.error
 import urllib.request
@@ -26,7 +25,6 @@ from .. import checker as cc
 from .. import cli
 from .. import client as jclient
 from .. import control as c
-from .. import core
 from .. import db as jdb
 from .. import generator as gen
 from ..checker import checkers as cks

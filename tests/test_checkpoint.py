@@ -1,0 +1,56 @@
+import random, os
+from jepsen_tpu.checker import jax_wgl
+from jepsen_tpu.models import cas_register_spec
+from jepsen_tpu.simulate import random_history
+
+
+def test_checkpoint_resume(tmp_path):
+    rng = random.Random(45100)
+    hist = random_history(rng, "cas-register", 6, 120, 0.05)
+    e, st = cas_register_spec.encode(hist)
+    ck = str(tmp_path / "frontier.npz")
+    # fresh full run for the expected verdict
+    want = jax_wgl.check_encoded(cas_register_spec, e, st)
+    # interrupted run: tiny chunks + instant timeout -> snapshot written
+    r1 = jax_wgl.check_encoded(cas_register_spec, e, st, chunk_iters=1,
+                               timeout_s=0, checkpoint=ck)
+    assert r1["valid"] == "unknown" and r1["error"] == "timeout"
+    assert os.path.exists(ck)
+    # resumed run completes from the snapshot and agrees, then cleans up
+    r2 = jax_wgl.check_encoded(cas_register_spec, e, st, chunk_iters=1,
+                               checkpoint=ck)
+    assert r2["valid"] == want["valid"]
+    assert r2["iterations"] >= r1["iterations"]
+    assert not os.path.exists(ck)
+
+
+def test_checkpoint_fingerprint_mismatch_ignored(tmp_path):
+    rng = random.Random(45100)
+    h1 = random_history(rng, "cas-register", 4, 40, 0.0)
+    h2 = random_history(rng, "cas-register", 4, 40, 0.0)
+    e1, st1 = cas_register_spec.encode(h1)
+    e2, st2 = cas_register_spec.encode(h2)
+    ck = str(tmp_path / "frontier.npz")
+    r = jax_wgl.check_encoded(cas_register_spec, e1, st1, chunk_iters=1,
+                              timeout_s=0, checkpoint=ck)
+    assert os.path.exists(ck)
+    # a different history must not resume from this snapshot
+    r2 = jax_wgl.check_encoded(cas_register_spec, e2, st2, checkpoint=ck)
+    assert r2["valid"] in (True, False)
+
+
+def test_checkpoint_kept_on_budget_exhaustion(tmp_path):
+    """An undecided max-configs run keeps its snapshot so a bigger-budget
+    rerun resumes instead of restarting."""
+    rng = random.Random(2)
+    hist = random_history(rng, "cas-register", 6, 120, 0.05)
+    e, st = cas_register_spec.encode(hist)
+    ck = str(tmp_path / "frontier.npz")
+    r1 = jax_wgl.check_encoded(cas_register_spec, e, st, chunk_iters=1,
+                               max_configs=1, checkpoint=ck)
+    assert r1["valid"] == "unknown"
+    assert os.path.exists(ck)
+    assert r1.get("checkpoint") == ck
+    r2 = jax_wgl.check_encoded(cas_register_spec, e, st, checkpoint=ck)
+    assert r2["valid"] in (True, False)
+    assert not os.path.exists(ck)

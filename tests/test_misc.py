@@ -1,0 +1,17 @@
+"""report/codec helper tests (reference report.clj, codec.clj)."""
+
+from jepsen_tpu import codec, report
+
+
+def test_report_to(tmp_path):
+    p = tmp_path / "out" / "summary.txt"
+    with report.to(str(p)):
+        print("all good")
+    assert p.read_text() == "all good\n"
+
+
+def test_codec_roundtrip():
+    assert codec.decode(codec.encode({"a": [1, 2]})) == {"a": [1, 2]}
+    assert codec.encode(None) == b""
+    assert codec.decode(b"") is None
+    assert codec.decode(None) is None

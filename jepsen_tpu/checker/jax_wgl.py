@@ -367,14 +367,13 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
                                     axis=1)[:, 0],
                 best_state)
 
-        # -- combined lane order (expansion then chain) ---------------------
-        # Later lanes land higher on the stack, so order lanes as:
-        # expansion in (w asc, c desc) -- putting the deepest parent's
-        # earliest-deadline child last among expansions -- then the chain
-        # ascending, so the chain's deepest config tops the stack.
-        exp_lin = jnp.flip(lin2, axis=2).reshape(K, M, B)
-        exp_st = jnp.flip(st2, axis=2).reshape(K, M, S)
-        exp_val = jnp.flip(child_valid, axis=2).reshape(K, M)
+        # -- combined lanes (expansion then chain, natural order) -----------
+        # Stack positions are assigned ARITHMETICALLY below so lane data
+        # never needs reordering (flipping the (K,M,B) tensors every
+        # iteration costs real bandwidth).
+        exp_lin = lin2.reshape(K, M, B)
+        exp_st = st2.reshape(K, M, S)
+        exp_val = child_valid.reshape(K, M)
         if R:
             all_lin = jnp.concatenate([exp_lin, ch_lin], axis=1)
             all_st = jnp.concatenate([exp_st, ch_st], axis=1)
@@ -430,12 +429,27 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
         tab2 = tab2.at[wslot].set(h2, mode="drop")
 
         # -- push fresh configs (per-key positions, one flat scatter) -------
-        # Lanes are already in push order (see combined lane order above):
-        # ascending positions put the last fresh lane -- the chain's
-        # deepest config -- on top of the stack for the next pop.
+        # Stack order (ascending position = popped sooner next time):
+        # expansion lanes in (w asc, c desc) -- so the deepest popped
+        # parent's best-priority child sits highest among expansions --
+        # then the chain ascending, its deepest config on the very top.
+        # Ranks are computed from cumsums over the masks alone; lane
+        # DATA stays in natural order.
         fresh = (cv & ~dup & ~seen).reshape(K, ML)
-        offs = jnp.cumsum(fresh.astype(jnp.int32), axis=1) - 1
-        cnt = offs[:, -1] + 1                                  # (K,)
+        fe = fresh[:, :M].reshape(K, W, C).astype(jnp.int32)
+        row_tot = fe.sum(axis=2)                               # (K,W)
+        rows_before = jnp.cumsum(row_tot, axis=1) - row_tot
+        suffix_in_row = row_tot[:, :, None] - jnp.cumsum(fe, axis=2)
+        rank_e = (rows_before[:, :, None] + suffix_in_row).reshape(K, M)
+        exp_total = row_tot.sum(axis=1)                        # (K,)
+        if R:
+            fc_ = fresh[:, M:].astype(jnp.int32)
+            rank_c = exp_total[:, None] + jnp.cumsum(fc_, axis=1) - 1
+            offs = jnp.concatenate([rank_e, rank_c], axis=1)
+            cnt = exp_total + fc_.sum(axis=1)
+        else:
+            offs = rank_e
+            cnt = exp_total
         pos = top[:, None] + offs
         dropped = dropped | (running & (top + cnt > O))
         fpos = jnp.where(fresh, arange_K[:, None] * O + pos % O,
@@ -625,13 +639,22 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     if checkpoint is not None:
         import hashlib
         h = hashlib.sha256()
-        for a in (inv32, ret32, fop, args, rets, ok_words,
+        h.update(spec.name.encode())
+        for a in (inv32, ret32, fop, args, rets, ok_words, init_state,
                   np.asarray([n_pad, B, S, C, W, O, T], np.int64)):
             h.update(np.ascontiguousarray(a).tobytes())
         fingerprint = h.hexdigest()
         resumed = _load_checkpoint(checkpoint, fingerprint)
         if resumed is not None:
             carry = tuple(jnp.asarray(x) for x in resumed)
+        elif not _checkpoint_owned(checkpoint, fingerprint):
+            # the path holds a different check's live snapshot; don't
+            # touch it (all later saves/cleanup honor this too)
+            import logging
+            logging.getLogger(__name__).warning(
+                "checkpoint %s belongs to a different check; "
+                "checkpointing disabled for this run", checkpoint)
+            checkpoint = None
     t0 = _time.monotonic()
     last_ckpt = t0
     timed_out = False
@@ -666,7 +689,10 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
                 **({"checkpoint": checkpoint} if checkpoint else {})}
     result = _interpret(spec, e, out, max_iters, confirm, init_state,
                         perm)
-    if checkpoint is not None:
+    # never clobber a snapshot that belongs to a DIFFERENT check (the
+    # mismatched-fingerprint case the load guard already ignores)
+    if checkpoint is not None and _checkpoint_owned(checkpoint,
+                                                    fingerprint):
         if result.get("valid") in (True, False):
             # decided: the snapshot is spent
             import contextlib as _ctx
@@ -679,6 +705,19 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
             _save_checkpoint(checkpoint, fingerprint, carry)
             result["checkpoint"] = checkpoint
     return result
+
+
+def _checkpoint_owned(path, fingerprint):
+    """True when path is free or holds a snapshot with this
+    fingerprint."""
+    import os as _os
+    if not _os.path.exists(path):
+        return True
+    try:
+        with np.load(path) as data:
+            return bytes(data["fingerprint"]).decode() == fingerprint
+    except Exception:  # noqa: BLE001 - corrupt file: treat as free
+        return True
 
 
 def _save_checkpoint(path, fingerprint, carry):

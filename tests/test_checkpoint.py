@@ -54,3 +54,40 @@ def test_checkpoint_kept_on_budget_exhaustion(tmp_path):
     r2 = jax_wgl.check_encoded(cas_register_spec, e, st, checkpoint=ck)
     assert r2["valid"] in (True, False)
     assert not os.path.exists(ck)
+
+
+def test_checkpoint_of_other_check_preserved(tmp_path):
+    """A run pointed at another check's snapshot must not destroy it."""
+    rng = random.Random(3)
+    h1 = random_history(rng, "cas-register", 6, 120, 0.05)
+    h2 = random_history(rng, "cas-register", 4, 40, 0.0)
+    e1, st1 = cas_register_spec.encode(h1)
+    e2, st2 = cas_register_spec.encode(h2)
+    ck = str(tmp_path / "frontier.npz")
+    jax_wgl.check_encoded(cas_register_spec, e1, st1, chunk_iters=1,
+                          timeout_s=0, checkpoint=ck)
+    before = open(ck, "rb").read()
+    # a different decided check at the same path: snapshot untouched
+    r = jax_wgl.check_encoded(cas_register_spec, e2, st2, checkpoint=ck)
+    assert r["valid"] in (True, False)
+    assert open(ck, "rb").read() == before
+    # resuming the original still works
+    r1 = jax_wgl.check_encoded(cas_register_spec, e1, st1, checkpoint=ck)
+    assert r1["valid"] in (True, False)
+
+
+def test_checkpoint_fingerprint_covers_init_state(tmp_path):
+    rng = random.Random(4)
+    hist = random_history(rng, "cas-register", 4, 40, 0.0)
+    e, st = cas_register_spec.encode(hist)
+    ck = str(tmp_path / "frontier.npz")
+    jax_wgl.check_encoded(cas_register_spec, e, st, chunk_iters=1,
+                          timeout_s=0, checkpoint=ck)
+    import numpy as np
+    st2 = np.asarray(st).copy()
+    st2[0] = st2[0] + 1
+    # different init state: must not resume the stale frontier
+    r = jax_wgl.check_encoded(cas_register_spec, e, st2)
+    ck2 = str(tmp_path / "other.npz")
+    r2 = jax_wgl.check_encoded(cas_register_spec, e, st2, checkpoint=ck2)
+    assert r2["valid"] == r["valid"]

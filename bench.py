@@ -149,6 +149,20 @@ def main():
     rungs["4-fifo-queue"] = {
         "ops": len(e4), "procs": 6,
         "device_s": round(d4, 1), "device_valid": r4["valid"],
+        "engine": r4.get("engine"),
+    }
+
+    # rung 4b: info-free FIFO at 25x the search's reach -- decided by
+    # the exact aspect (bad-pattern) fast path
+    hist4b = random_history(rng, "fifo-queue", n_procs=16, n_ops=5000,
+                            crash_p=0.0)
+    e4b, st4b = fifo_queue_spec.encode(hist4b)
+    t0 = time.monotonic()
+    r4b = jax_wgl.check_encoded(fifo_queue_spec, e4b, st4b)
+    rungs["4b-fifo-aspect-5k"] = {
+        "ops": len(e4b), "procs": 16,
+        "device_s": round(time.monotonic() - t0, 2),
+        "device_valid": r4b["valid"], "engine": r4b.get("engine"),
     }
 
     # -- rung 5: the stretch goal ----------------------------------------

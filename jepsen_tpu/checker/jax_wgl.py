@@ -550,6 +550,28 @@ def _encode_arrays(e):
     return inv32, ret32, ok_words
 
 
+def _fast_result(spec, e, init_state, fast, confirm=False):
+    """Shape a fast_check decision like a search result, including the
+    failure witness op and optional oracle confirmation."""
+    result = {"configs_explored": 0, "iterations": 0, "engine": "aspect"}
+    if fast is True:
+        result["valid"] = True
+        return result
+    valid, info = fast
+    result["valid"] = valid
+    result.update({k: v for k, v in info.items() if k != "op_index"})
+    i = info.get("op_index")
+    if i is not None and e.ops is not None:
+        inv, comp = e.ops[i]
+        result["op"] = dict(comp if comp is not None else inv)
+    if confirm:
+        from . import wgl
+        oracle = wgl.check_encoded(spec, e, init_state)
+        result["confirmed"] = oracle["valid"] is valid
+        result["valid"] = oracle["valid"]
+    return result
+
+
 def _priority_order(spec, e, inv32, ret32):
     """Renumber ops into linearization-priority order: argsort by the
     model hint (default: earliest deadline / return index). The kernel
@@ -595,6 +617,12 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
         return {"valid": True, "configs_explored": 0}
 
     inv32, ret32, _ = _encode_arrays(e)
+    if spec.fast_check is not None:
+        fast = spec.fast_check(e, inv32, ret32)
+        if fast is not None:
+            # exact polynomial decision (e.g. queue bad patterns) --
+            # no search needed at any history size
+            return _fast_result(spec, e, init_state, fast, confirm)
     C = max_point_concurrency(inv32, np.where(ret32 == INF32,
                                               INF_TIME, ret32.astype(np.int64)))
     A = int(e.args.shape[1]) if e.args.ndim == 2 else 1

@@ -122,6 +122,12 @@ class ModelSpec:
     # their values' dequeue order (an enqueue must linearize before the
     # dequeue that returns its value).
     hint: Callable = None
+    # optional fn(e, invoke32, ret32) -> True | False | None: an EXACT
+    # polynomial-time decision procedure for the subclass of histories it
+    # understands (None = can't decide, fall back to search). Queues use
+    # aspect-style bad-pattern detection, which scales where the NP-hard
+    # search cannot.
+    fast_check: Callable = None
 
     def encode(self, hist):
         """Encode an event history for this model. Returns (EncodedHistory,

@@ -153,6 +153,94 @@ def _fifo_hint(e, inv32, ret32):
     return np.clip(pri, -(2 ** 31), 2 ** 31 - 1).astype(np.int32)
 
 
+def _fifo_fast_check(e, inv32, ret32):
+    """Aspect-style polynomial decision for FIFO histories (after
+    Henzinger/Sezgin/Vafeiadis-style bad patterns; values are unique and
+    dequeues always return a value in this model).
+
+    Certain-invalidity patterns (sound even with info ops):
+      i.  an ok dequeue of a value nobody enqueued, or dequeued twice
+      ii. a dequeue completing before its value's enqueue was invoked
+      iii. FIFO order violation: enq(a) really-before enq(b), yet
+           deq(b) really-before deq(a) (both dequeues ok)
+      iv. enq(a) really-before enq(b), b ok-dequeued, a (ok-enqueued)
+          never dequeued -- certain only when no info dequeues exist
+          (one could have consumed a) and no info enq took a's value.
+
+    Exact validity: an info-free complete history with none of the
+    patterns is linearizable. With info ops, absence of patterns proves
+    nothing -> None (search decides).
+
+    Returns True, None, or (False, {"op_index", "pattern"}) -- the
+    offending op becomes the failure witness."""
+    n = len(e)
+    if n == 0:
+        return True
+    f = np.asarray(e.f)
+    is_ok = np.asarray(e.is_ok, bool)
+    # this procedure assumes every dequeue's return value is known
+    deq_mask = (f == F_DEQUEUE)
+    ok_deq = deq_mask & is_ok
+    if np.any(np.asarray(e.ret)[ok_deq, 0] == NIL):
+        return None
+    enq_of = {}
+    for i in np.flatnonzero(f == F_ENQUEUE):
+        v = int(e.args[i][0])
+        if v in enq_of:
+            return None    # duplicate enqueue values: out of scope
+        enq_of[v] = i
+    deq_of = {}
+    for i in np.flatnonzero(ok_deq):
+        v = int(e.ret[i][0])
+        if v in deq_of:
+            return False, {"op_index": int(i),
+                           "pattern": "double-dequeue"}
+        deq_of[v] = i
+        j = enq_of.get(v)
+        if j is None:
+            return False, {"op_index": int(i),
+                           "pattern": "dequeue-of-unknown-value"}
+        if ret32[i] < inv32[j]:
+            return False, {"op_index": int(i),
+                           "pattern": "dequeue-before-enqueue"}
+    # (iii): order violations among dequeued values, vectorized
+    vals = sorted(deq_of)
+    if vals:
+        ej = np.asarray([enq_of[v] for v in vals])
+        dj = np.asarray([deq_of[v] for v in vals])
+        enq_ret = ret32[ej].astype(np.int64)
+        enq_inv = inv32[ej].astype(np.int64)
+        deq_ret = ret32[dj].astype(np.int64)
+        deq_inv = inv32[dj].astype(np.int64)
+        a_before_b = enq_ret[:, None] < enq_inv[None, :]
+        db_before_da = deq_ret[None, :] < deq_inv[:, None]
+        bad = a_before_b & db_before_da
+        if np.any(bad):
+            ai, bi = np.argwhere(bad)[0]
+            return False, {"op_index": int(dj[bi]),
+                           "pattern": "fifo-order-violation",
+                           "enqueued-after": int(ej[ai])}
+    has_info = bool((~is_ok).any())
+    no_info_deq = not bool((deq_mask & ~is_ok).any())
+    # (iv): a stuck ahead of a dequeued b
+    if no_info_deq and vals:
+        undeq_ok = [enq_of[v] for v in enq_of
+                    if v not in deq_of and is_ok[enq_of[v]]]
+        if undeq_ok:
+            ua = np.asarray(undeq_ok)
+            ej = np.asarray([enq_of[v] for v in vals])
+            bad = (ret32[ua].astype(np.int64)[:, None]
+                   < inv32[ej].astype(np.int64)[None, :])
+            if np.any(bad):
+                ai, bi = np.argwhere(bad)[0]
+                return False, {"op_index": int(dj[bi]),
+                               "pattern": "dequeue-past-stuck-value",
+                               "stuck-enqueue": int(ua[ai])}
+    if not has_info:
+        return True
+    return None
+
+
 fifo_queue_spec = register_model(ModelSpec(
     name="fifo-queue",
     f_codes={"enqueue": F_ENQUEUE, "dequeue": F_DEQUEUE},
@@ -165,6 +253,7 @@ fifo_queue_spec = register_model(ModelSpec(
     encode_op=_queue_encode,
     pad_state=_pad_nil,
     hint=_fifo_hint,
+    fast_check=_fifo_fast_check,
 ))
 
 

@@ -36,12 +36,12 @@ from ..checker.jax_wgl import (INF32, KEYED, RUNNING, _bucket, _build_search,
 from ..history import INF_TIME
 
 
-def _pad_key(e, init_state, spec, n_pad, S_pad, A):
+def _pad_key(e, init_state, spec, n_pad, S_pad, A, enc=None):
     """Priority-sort one key's encoded arrays (see
     jax_wgl._priority_order) and pad to the common bucket sizes. Returns
     the padded columns plus the priority perm for witness decoding."""
     n = len(e)
-    inv32, ret32, _ = _encode_arrays(e)
+    inv32, ret32, _ = enc if enc is not None else _encode_arrays(e)
     perm, inv32, ret32, fop, args, rets, ok_words = \
         jax_wgl._priority_order(spec, e, inv32, ret32)
     pn = n_pad - n
@@ -100,11 +100,19 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
 
     results = [None] * K_real
     live = []
+    encs = {}
     for k, (e, st) in enumerate(pairs):
         if len(e) == 0 or e.n_ok == 0:
             results[k] = {"valid": True, "configs_explored": 0}
-        else:
-            live.append(k)
+            continue
+        enc = _encode_arrays(e)          # computed once, reused below
+        if spec.fast_check is not None:
+            fast = spec.fast_check(e, enc[0], enc[1])
+            if fast is not None:
+                results[k] = jax_wgl._fast_result(spec, e, st, fast)
+                continue
+        encs[k] = enc
+        live.append(k)
     if not live:
         return results
 
@@ -117,8 +125,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         S_pad = _bucket(S_pad, 2)
     C = 4
     for k in live:
-        e = pairs[k][0]
-        inv32, ret32, _ = _encode_arrays(e)
+        inv32, ret32, _ = encs[k]
         C = max(C, max_point_concurrency(
             inv32, np.where(ret32 == INF32, INF_TIME,
                             ret32.astype(np.int64))))
@@ -136,7 +143,8 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     O = max(4096, O // _bucket(min(n_live, 8), 1))
     max_iters = max(64, max_configs // (W * n_live))
 
-    cols = [_pad_key(pairs[k][0], pairs[k][1], spec, n_pad, S_pad, A)
+    cols = [_pad_key(pairs[k][0], pairs[k][1], spec, n_pad, S_pad, A,
+                     encs[k])
             for k in live]
     salts = [np.uint32(k + 1) for k in live]
     # pad the key batch with dummy keys (exhaust immediately) up to a power

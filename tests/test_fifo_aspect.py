@@ -1,0 +1,97 @@
+"""FIFO aspect-checker differential tests: the polynomial bad-pattern
+decision must agree exactly with the sequential WGL oracle wherever it
+answers (it is used as an exact fast path, not a heuristic)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker import jax_wgl, wgl
+from jepsen_tpu.models import fifo_queue_spec
+from jepsen_tpu.models.queues import _fifo_fast_check
+from jepsen_tpu.simulate import corrupt, random_history
+
+
+def _decide(hist):
+    e, st = fifo_queue_spec.encode(hist)
+    inv32, ret32, _ = jax_wgl._encode_arrays(e)
+    fast = _fifo_fast_check(e, inv32, ret32)
+    if isinstance(fast, tuple):
+        fast = fast[0]
+    return e, st, fast
+
+
+def test_differential_vs_oracle_many_seeds():
+    agree = decided = 0
+    for seed in range(60):
+        rng = random.Random(seed)
+        crash = 0.0 if seed % 2 == 0 else 0.08
+        hist = random_history(rng, "fifo-queue", n_procs=4, n_ops=30,
+                              crash_p=crash)
+        if seed % 3 == 2:
+            hist = corrupt(rng, hist)
+        e, st, fast = _decide(hist)
+        want = wgl.check_encoded(fifo_queue_spec, e, st)["valid"]
+        if fast is not None:
+            decided += 1
+            assert fast == want, f"seed {seed}: aspect={fast} oracle={want}"
+            agree += 1
+    # info-free seeds must all be decided
+    assert decided >= 20
+
+
+def test_info_free_histories_always_decided():
+    for seed in range(10):
+        rng = random.Random(1000 + seed)
+        hist = random_history(rng, "fifo-queue", n_procs=6, n_ops=40,
+                              crash_p=0.0)
+        _, _, fast = _decide(hist)
+        assert fast is True
+
+
+def test_big_valid_history_instant():
+    rng = random.Random(45100)
+    hist = random_history(rng, "fifo-queue", n_procs=16, n_ops=5000,
+                          crash_p=0.0)
+    e, st = fifo_queue_spec.encode(hist)
+    r = jax_wgl.check_encoded(fifo_queue_spec, e, st)
+    assert r["valid"] is True
+    assert r["engine"] == "aspect"
+
+
+def test_big_corrupt_history_instant():
+    rng = random.Random(45100)
+    hist = random_history(rng, "fifo-queue", n_procs=16, n_ops=5000,
+                          crash_p=0.0)
+    hist = corrupt(rng, hist)
+    e, st = fifo_queue_spec.encode(hist)
+    r = jax_wgl.check_encoded(fifo_queue_spec, e, st)
+    assert r["valid"] is False
+    assert r["engine"] == "aspect"
+
+
+def test_info_histories_fall_back_to_search():
+    rng = random.Random(3)
+    hist = random_history(rng, "fifo-queue", n_procs=4, n_ops=30,
+                          crash_p=0.2)
+    e, st, fast = _decide(hist)
+    if fast is None:
+        r = jax_wgl.check_encoded(fifo_queue_spec, e, st)
+        assert r["engine"] == "jax-wgl"
+        assert r["valid"] == wgl.check_encoded(
+            fifo_queue_spec, e, st)["valid"]
+
+
+def test_aspect_invalid_carries_witness():
+    rng = random.Random(45100)
+    hist = random_history(rng, "fifo-queue", n_procs=8, n_ops=200,
+                          crash_p=0.0)
+    hist = corrupt(rng, hist)
+    e, st = fifo_queue_spec.encode(hist)
+    r = jax_wgl.check_encoded(fifo_queue_spec, e, st)
+    assert r["valid"] is False and r["engine"] == "aspect"
+    assert "pattern" in r
+    assert r["op"]["f"] == "dequeue"
+    # confirm runs the oracle over the same history
+    r2 = jax_wgl.check_encoded(fifo_queue_spec, e, st, confirm=True)
+    assert r2["confirmed"] is True

@@ -1,0 +1,22 @@
+"""repl helper tests (reference repl.clj)."""
+
+import pytest
+
+from jepsen_tpu import core, repl, store, tests as tst
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+
+
+def test_latest_test_and_history():
+    assert repl.latest_test() is None
+    t = tst.noop_test()
+    t["ssh"] = {"dummy?": True}
+    t["generator"] = {"f": "nop"}
+    core.run(t)
+    latest = repl.latest_test()
+    assert latest is not None and latest["name"] == "noop"
+    hist = repl.latest_history()
+    assert isinstance(hist, list)

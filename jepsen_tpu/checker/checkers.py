@@ -96,15 +96,17 @@ class Linearizable(Checker):
         self.engine_opts = engine_opts or {}
 
     def check(self, test, hist, opts=None):
-        from . import jax_wgl, wgl
+        from . import jax_wgl, linear, wgl
         client_hist = [o for o in hist
                        if isinstance(o.get("process"), int)
                        or o.get("type") in ("invoke", "ok", "fail", "info")
                        and o.get("process") != "nemesis"]
         e, init_state = self.spec.encode(client_hist)
         algo = self.algorithm
-        if algo in ("wgl", "linear"):
+        if algo == "wgl":
             a = wgl.check_encoded(self.spec, e, init_state)
+        elif algo == "linear":
+            a = linear.check_encoded(self.spec, e, init_state)
         elif algo == "jax-wgl":
             a = jax_wgl.check_encoded(self.spec, e, init_state,
                                       **self.engine_opts)
@@ -131,7 +133,7 @@ class Linearizable(Checker):
         checker.clj:199-202). If the first engine to finish returns
         "unknown" (config-budget overflow, timeout, crash), wait for the
         other engine and prefer its verdict when definite."""
-        from . import jax_wgl, wgl
+        from . import jax_wgl, linear, wgl
         first_done = threading.Event()
         results = {}
         order = []
@@ -147,28 +149,47 @@ class Linearizable(Checker):
                 order.append(name)
             first_done.set()
 
-        # the oracle gets a config budget so it yields on hard searches
-        t1 = threading.Thread(
-            target=run, args=("wgl", lambda: wgl.check_encoded(
-                self.spec, e, init_state, max_configs=2_000_000)),
-            daemon=True)
-        t2 = threading.Thread(
-            target=run, args=("jax-wgl", lambda: jax_wgl.check_encoded(
-                self.spec, e, init_state, **self.engine_opts)),
-            daemon=True)
-        t1.start()
-        t2.start()
-        first_done.wait()
-        with lock:
-            name = order[0]
-            r = results[name]
-        if r.get("valid") == "unknown":
-            t1.join()
-            t2.join()
-            for other, r2 in results.items():
-                if other != name and r2.get("valid") != "unknown":
-                    name, r = other, r2
+        # the CPU engines get config budgets so they yield on hard
+        # searches; knossos.competition likewise races linear + wgl
+        cancel = threading.Event()
+        threads = [
+            threading.Thread(
+                target=run, args=("wgl", lambda: wgl.check_encoded(
+                    self.spec, e, init_state, max_configs=2_000_000)),
+                daemon=True),
+            threading.Thread(
+                target=run,
+                args=("linear", lambda: linear.check_encoded(
+                    self.spec, e, init_state, max_configs=200_000)),
+                daemon=True),
+            threading.Thread(
+                target=run,
+                args=("jax-wgl", lambda: jax_wgl.check_encoded(
+                    self.spec, e, init_state, cancel=cancel,
+                    **self.engine_opts)),
+                daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        # wait for the first DEFINITE verdict (or everyone to give up)
+        while True:
+            first_done.wait()
+            with lock:
+                first_done.clear()
+                definite = [(nm, results[nm]) for nm in order
+                            if results[nm].get("valid") != "unknown"]
+                if definite:
+                    name, r = definite[0]
                     break
+                if len(order) == len(threads):
+                    name, r = order[0], results[order[0]]
+                    break
+        # ask the device engine to stop (it checks `cancel` between
+        # chunks). Join only briefly: a compile in flight can take tens
+        # of seconds and the verdict is already in hand -- the daemon
+        # thread drains itself once the dispatch returns.
+        cancel.set()
+        threads[2].join(timeout=1)
         r = dict(r)
         r["engine"] = name
         return r

@@ -597,7 +597,7 @@ def _priority_order(spec, e, inv32, ret32):
 def check_encoded(spec, e, init_state, max_configs=50_000_000,
                   frontier_width=None, stack_size=None, table_size=None,
                   confirm=False, timeout_s=None, chunk_iters=256,
-                  checkpoint=None, checkpoint_every_s=60.0):
+                  checkpoint=None, checkpoint_every_s=60.0, cancel=None):
     """Device WGL search over an EncodedHistory. Result dict mirrors
     wgl.check_encoded: {"valid": True|False|"unknown", "configs_explored",
     ...}, plus device budget diagnostics. ``timeout_s`` bounds wall clock
@@ -699,7 +699,8 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
                 now - last_ckpt >= checkpoint_every_s:
             _save_checkpoint(checkpoint, fingerprint, carry)
             last_ckpt = now
-        if timeout_s is not None and now - t0 > timeout_s:
+        if (timeout_s is not None and now - t0 > timeout_s) or \
+                (cancel is not None and cancel.is_set()):
             timed_out = True
             if checkpoint is not None:
                 _save_checkpoint(checkpoint, fingerprint, carry)

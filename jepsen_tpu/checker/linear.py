@@ -1,0 +1,128 @@
+"""Just-in-time linearization (knossos.linear's algorithm,
+reconstructed from Lowe's "Testing for linearizability" description;
+the reference dispatches to it via checker.clj:199-202).
+
+Where WGL searches depth-first over whole linearization orders, JIT
+linearization sweeps the history's *events* in time order, maintaining
+the set of all configurations (linearized-bitset, model state)
+consistent with the prefix seen so far:
+
+* at an invocation, nothing changes (the op merely becomes available);
+* at a return of op i, every configuration must catch up: it may first
+  linearize any sequence of currently-open ops, but must end up with i
+  linearized — configurations that can't are discarded; if the set
+  empties, the history is not linearizable, with the return event as
+  the witness;
+* info ops never return, so they are never forced; at the end the
+  history is linearizable iff any configuration survived (every ok op
+  was forced by its own return event).
+
+The config-set stays small on low-contention histories (each return
+usually extends every config by a handful of ops), which is exactly
+when this algorithm beats WGL — and why the reference's competition
+races both. The set is bounded (``max_configs`` per event); overflow
+returns unknown rather than ever mis-deciding.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..history import INF_TIME
+
+
+def check_encoded(spec, e, init_state, max_configs=100_000):
+    """JIT-linearization over an EncodedHistory. Returns
+    {"valid": True|False|"unknown", "configs_explored", "engine",
+    "op"/... witness fields on failure}."""
+    n = len(e)
+    if n == 0 or e.n_ok == 0:
+        return {"valid": True, "configs_explored": 0, "engine": "linear"}
+
+    invoke = e.invoke_idx
+    ret_t = e.return_idx
+    step = spec.step
+    f, args, rets = e.f, e.args, e.ret
+
+    # events in time order: (t, kind, op); returns processed at their
+    # time; invokes only open the op
+    events = sorted(
+        [(int(invoke[i]), 0, i) for i in range(n)]
+        + [(int(ret_t[i]), 1, i) for i in range(n)
+           if ret_t[i] < INF_TIME])
+
+    init = np.asarray(init_state, np.int32)
+    # config: (bitset int, state bytes); states interned to arrays
+    states = {init.tobytes(): init}
+    configs = {(0, init.tobytes())}
+    open_ops: list[int] = []
+    explored = 0
+
+    def expand_until(target, configs):
+        """Closure: linearize sequences of open ops until `target` is
+        linearized in every surviving config; returns the set of
+        configs with target linearized (deduped), or None on
+        overflow."""
+        nonlocal explored
+        done = set()
+        frontier = set()
+        seen = set(configs)
+        for c in configs:
+            (done if (c[0] >> target) & 1 else frontier).add(c)
+        while frontier:
+            nxt = set()
+            for lin, skey in frontier:
+                st = states[skey]
+                for j in open_ops:
+                    if (lin >> j) & 1:
+                        continue
+                    st2, ok = step(st, f[j], args[j], rets[j], np)
+                    explored += 1
+                    if not ok:
+                        continue
+                    st2 = np.asarray(st2, np.int32)
+                    key2 = st2.tobytes()
+                    if key2 not in states:
+                        states[key2] = st2
+                    c2 = (lin | (1 << j), key2)
+                    if c2 in seen:
+                        continue
+                    seen.add(c2)
+                    if (c2[0] >> target) & 1:
+                        done.add(c2)
+                    else:
+                        nxt.add(c2)
+                    if len(seen) > max_configs:
+                        return None
+            frontier = nxt
+        return done
+
+    for t, kind, i in events:
+        if kind == 0:
+            open_ops.append(i)
+            continue
+        # return of op i: every config must have i linearized by now
+        got = expand_until(i, configs)
+        if got is None:
+            return {"valid": "unknown", "error": "max-configs-exceeded",
+                    "configs_explored": explored, "engine": "linear"}
+        open_ops.remove(i)
+        if not got:
+            result = {"valid": False, "configs_explored": explored,
+                      "engine": "linear"}
+            if e.ops is not None:
+                inv, comp = e.ops[i]
+                result["op"] = dict(comp if comp is not None else inv)
+            # deepest surviving prefix for the witness
+            if configs:
+                lin, skey = max(configs, key=lambda c: bin(c[0]).count("1"))
+                result["final_state"] = states[skey].tolist()
+            return result
+        configs = got
+    return {"valid": True, "configs_explored": explored,
+            "engine": "linear"}
+
+
+def check_history(spec, history, **kw):
+    e, init_state = spec.encode(history)
+    return check_encoded(spec, e, init_state, **kw)

@@ -524,8 +524,8 @@ def test_linearizable_ignores_nemesis_ops():
 
 def test_competition_unknown_winner_defers_to_loser(monkeypatch):
     """If the first engine to finish returns unknown, competition must wait
-    for the other and take its definite verdict (checker.clj:199-202)."""
-    from jepsen_tpu.checker import jax_wgl, wgl
+    for another and take its definite verdict (checker.clj:199-202)."""
+    from jepsen_tpu.checker import jax_wgl, linear, wgl
 
     def fast_unknown(spec, e, init_state, **kw):
         return {"valid": "unknown", "error": "budget"}
@@ -538,6 +538,7 @@ def test_competition_unknown_winner_defers_to_loser(monkeypatch):
         return real(spec, e, init_state)
 
     monkeypatch.setattr(jax_wgl, "check_encoded", fast_unknown)
+    monkeypatch.setattr(linear, "check_encoded", fast_unknown)
     monkeypatch.setattr(wgl, "check_encoded", slow_definite)
     c = ck.linearizable({"model": "cas-register"})
     r = check(c, GOOD_CAS)
@@ -545,13 +546,14 @@ def test_competition_unknown_winner_defers_to_loser(monkeypatch):
     assert r["engine"] == "wgl"
 
 
-def test_competition_both_unknown(monkeypatch):
-    from jepsen_tpu.checker import jax_wgl, wgl
+def test_competition_all_unknown(monkeypatch):
+    from jepsen_tpu.checker import jax_wgl, linear, wgl
 
     def unknown(spec, e, init_state, **kw):
         return {"valid": "unknown", "error": "budget"}
 
     monkeypatch.setattr(jax_wgl, "check_encoded", unknown)
+    monkeypatch.setattr(linear, "check_encoded", unknown)
     monkeypatch.setattr(wgl, "check_encoded", unknown)
     c = ck.linearizable({"model": "cas-register"})
     r = check(c, GOOD_CAS)

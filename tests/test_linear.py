@@ -1,0 +1,88 @@
+"""JIT-linearization engine tests: differential against the WGL oracle
+over randomized histories and golden cases (knossos.linear equivalent)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker import linear, wgl
+from jepsen_tpu.models import (cas_register_spec, fifo_queue_spec,
+                               mutex_spec, register_spec)
+from jepsen_tpu.simulate import corrupt, random_history
+
+
+def test_golden_register():
+    ms = 1_000_000
+    hist = [
+        {"type": "invoke", "process": 0, "f": "write", "value": 1,
+         "time": 0, "index": 0},
+        {"type": "ok", "process": 0, "f": "write", "value": 1,
+         "time": 1 * ms, "index": 1},
+        {"type": "invoke", "process": 1, "f": "read", "value": None,
+         "time": 2 * ms, "index": 2},
+        {"type": "ok", "process": 1, "f": "read", "value": 1,
+         "time": 3 * ms, "index": 3},
+    ]
+    assert linear.check_history(register_spec, hist)["valid"] is True
+    hist[3] = dict(hist[3], value=2)
+    r = linear.check_history(register_spec, hist)
+    assert r["valid"] is False
+    assert r["op"]["f"] == "read"     # witness: the return that failed
+
+
+@pytest.mark.parametrize("spec,name", [
+    (cas_register_spec, "cas-register"),
+    (mutex_spec, "mutex"),
+    (fifo_queue_spec, "fifo-queue"),
+])
+def test_differential_vs_wgl(spec, name):
+    for seed in range(25):
+        rng = random.Random(seed)
+        hist = random_history(rng, name, n_procs=4, n_ops=24,
+                              crash_p=0.08)
+        if seed % 3 == 2:
+            hist = corrupt(rng, hist)
+        e, st = spec.encode(hist)
+        got = linear.check_encoded(spec, e, st)
+        if got["valid"] == "unknown":
+            continue
+        want = wgl.check_encoded(spec, e, st)
+        assert got["valid"] == want["valid"], f"{name} seed {seed}"
+
+
+def test_info_ops_not_forced():
+    # a crashed write may or may not have happened; both reads explainable
+    hist = [
+        {"type": "invoke", "process": 0, "f": "write", "value": 3,
+         "time": 0, "index": 0},
+        {"type": "info", "process": 0, "f": "write", "value": 3,
+         "time": 1, "index": 1},
+        {"type": "invoke", "process": 1, "f": "read", "value": None,
+         "time": 2, "index": 2},
+        {"type": "ok", "process": 1, "f": "read", "value": 3,
+         "time": 3, "index": 3},
+    ]
+    assert linear.check_history(register_spec, hist)["valid"] is True
+    hist[3] = dict(hist[3], value=None)
+    assert linear.check_history(register_spec, hist)["valid"] is True
+
+
+def test_overflow_returns_unknown():
+    rng = random.Random(45100)
+    hist = random_history(rng, "cas-register", n_procs=8, n_ops=60,
+                          crash_p=0.3)
+    e, st = cas_register_spec.encode(hist)
+    r = linear.check_encoded(cas_register_spec, e, st, max_configs=4)
+    assert r["valid"] in ("unknown", True, False)
+    if r["valid"] == "unknown":
+        assert r["error"] == "max-configs-exceeded"
+
+
+def test_competition_uses_linear():
+    from jepsen_tpu.checker import checkers as ck
+    rng = random.Random(45100)
+    hist = random_history(rng, "cas-register", n_procs=4, n_ops=30,
+                          crash_p=0.05)
+    r = ck.linearizable({"model": "cas-register"}).check({}, hist)
+    assert r["valid"] is True
+    assert r["engine"] in ("wgl", "linear", "jax-wgl")

@@ -85,8 +85,9 @@ def stats():
 class Linearizable(Checker):
     """THE gate to the linearizability engines (checker.clj:185-216).
     algorithm: "wgl" (sequential CPU oracle), "jax-wgl" (batched device
-    search), "linear" (alias of wgl for now), or default "competition"
-    (races CPU oracle vs device engine; first verdict wins)."""
+    search), "linear" (just-in-time linearization; bounded config set,
+    may return "unknown" on overflow), or default "competition" (races
+    all three; the first definite verdict wins)."""
 
     def __init__(self, model, algorithm="competition", engine_opts=None):
         assert model is not None, \
@@ -155,12 +156,14 @@ class Linearizable(Checker):
         threads = [
             threading.Thread(
                 target=run, args=("wgl", lambda: wgl.check_encoded(
-                    self.spec, e, init_state, max_configs=2_000_000)),
+                    self.spec, e, init_state, max_configs=2_000_000,
+                    cancel=cancel)),
                 daemon=True),
             threading.Thread(
                 target=run,
                 args=("linear", lambda: linear.check_encoded(
-                    self.spec, e, init_state, max_configs=200_000)),
+                    self.spec, e, init_state, max_configs=200_000,
+                    cancel=cancel)),
                 daemon=True),
             threading.Thread(
                 target=run,
@@ -184,12 +187,14 @@ class Linearizable(Checker):
                 if len(order) == len(threads):
                     name, r = order[0], results[order[0]]
                     break
-        # ask the device engine to stop (it checks `cancel` between
-        # chunks). Join only briefly: a compile in flight can take tens
-        # of seconds and the verdict is already in hand -- the daemon
-        # thread drains itself once the dispatch returns.
+        # ask the losing engines to stop (checked between device chunks
+        # / every few thousand host configs). Join only briefly: a
+        # device compile in flight can take tens of seconds and the
+        # verdict is already in hand -- the daemon threads drain
+        # themselves once they next check the flag.
         cancel.set()
-        threads[2].join(timeout=1)
+        for t in threads:
+            t.join(timeout=0.5)
         r = dict(r)
         r["engine"] = name
         return r

@@ -31,7 +31,7 @@ import numpy as np
 from ..history import INF_TIME
 
 
-def check_encoded(spec, e, init_state, max_configs=100_000):
+def check_encoded(spec, e, init_state, max_configs=100_000, cancel=None):
     """JIT-linearization over an EncodedHistory. Returns
     {"valid": True|False|"unknown", "configs_explored", "engine",
     "op"/... witness fields on failure}."""
@@ -101,6 +101,9 @@ def check_encoded(spec, e, init_state, max_configs=100_000):
         if kind == 0:
             open_ops.append(i)
             continue
+        if cancel is not None and cancel.is_set():
+            return {"valid": "unknown", "error": "cancelled",
+                    "configs_explored": explored, "engine": "linear"}
         # return of op i: every config must have i linearized by now
         got = expand_until(i, configs)
         if got is None:

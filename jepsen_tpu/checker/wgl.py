@@ -32,7 +32,7 @@ import numpy as np
 from ..history import INF_TIME
 
 
-def check_encoded(spec, e, init_state, max_configs=None):
+def check_encoded(spec, e, init_state, max_configs=None, cancel=None):
     """Run the WGL search over an EncodedHistory ``e`` with ``init_state``.
 
     Returns a result dict:
@@ -74,6 +74,10 @@ def check_encoded(spec, e, init_state, max_configs=None):
         if max_configs is not None and explored > max_configs:
             return {"valid": "unknown", "configs_explored": explored,
                     "error": "max-configs-exceeded"}
+        if cancel is not None and explored % 4096 == 0 \
+                and cancel.is_set():
+            return {"valid": "unknown", "configs_explored": explored,
+                    "error": "cancelled"}
         unlin = full & ~lin
         # minimum return among unlinearized ops
         r_min = INF_TIME

@@ -550,6 +550,64 @@ def _encode_arrays(e):
     return inv32, ret32, ok_words
 
 
+def _state_abstraction_check(spec, e, init_state, max_states=4096,
+                             max_rounds=64):
+    """Sound invalidity pre-check: enumerate an over-approximation of
+    the reachable model states (fixpoint of applying every op to every
+    state, ignoring timing -- a superset of all linearization-prefix
+    states). An ok op whose step fails from EVERY reachable state can
+    appear in no linearization, so the history is invalid -- this
+    decides e.g. a read of a never-written value on histories far too
+    large to exhaust. Models with big state spaces overflow the cap and
+    return None (no claim)."""
+    n = len(e)
+    # distinct (f, args, ret) rows: a 10k-op register history has a
+    # few dozen, so the fixpoint is tiny regardless of history length
+    rows = np.concatenate(
+        [np.asarray(e.f, np.int32)[:, None],
+         np.asarray(e.args, np.int32).reshape(n, -1),
+         np.asarray(e.ret, np.int32).reshape(n, -1)], axis=1)
+    uniq, inverse = np.unique(rows, axis=0, return_inverse=True)
+    if len(uniq) > 512:
+        return None
+    A = np.asarray(e.args, np.int32).reshape(n, -1).shape[1]
+    uf = uniq[:, 0]
+    ua = uniq[:, 1:1 + A]
+    ur = uniq[:, 1 + A:]
+    states = {np.asarray(init_state, np.int32).tobytes():
+              np.asarray(init_state, np.int32)}
+    frontier = list(states.values())
+    # per-row "some reachable state accepts it", accumulated as the
+    # fixpoint steps every (state, row) pair exactly once
+    possible = np.zeros(len(uniq), bool)
+    for _ in range(max_rounds):
+        new = []
+        for st in frontier:
+            for u in range(len(uniq)):
+                st2, ok = spec.step(st, uf[u], ua[u], ur[u], np)
+                if not ok:
+                    continue
+                possible[u] = True
+                st2 = np.asarray(st2, np.int32)
+                key = st2.tobytes()
+                if key not in states:
+                    if len(states) >= max_states:
+                        return None
+                    states[key] = st2
+                    new.append(st2)
+        if not new:
+            break
+        frontier = new
+    else:
+        return None   # no fixpoint within the round budget
+    bad = np.flatnonzero(~possible[inverse] & np.asarray(e.is_ok, bool))
+    if len(bad):
+        return False, {"op_index": int(bad[0]),
+                       "pattern": "impossible-from-every-state",
+                       "reachable_states": len(states)}
+    return None
+
+
 def _fast_result(spec, e, init_state, fast, confirm=False):
     """Shape a fast_check decision like a search result, including the
     failure witness op and optional oracle confirmation."""
@@ -622,6 +680,10 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
         if fast is not None:
             # exact polynomial decision (e.g. queue bad patterns) --
             # no search needed at any history size
+            return _fast_result(spec, e, init_state, fast, confirm)
+    if spec.pad_state is None:   # fixed small state spaces only
+        fast = _state_abstraction_check(spec, e, init_state)
+        if fast is not None:
             return _fast_result(spec, e, init_state, fast, confirm)
     C = max_point_concurrency(inv32, np.where(ret32 == INF32,
                                               INF_TIME, ret32.astype(np.int64)))

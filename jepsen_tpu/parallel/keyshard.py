@@ -106,11 +106,13 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
             results[k] = {"valid": True, "configs_explored": 0}
             continue
         enc = _encode_arrays(e)          # computed once, reused below
-        if spec.fast_check is not None:
-            fast = spec.fast_check(e, enc[0], enc[1])
-            if fast is not None:
-                results[k] = jax_wgl._fast_result(spec, e, st, fast)
-                continue
+        fast = (spec.fast_check(e, enc[0], enc[1])
+                if spec.fast_check is not None else None)
+        if fast is None and spec.pad_state is None:
+            fast = jax_wgl._state_abstraction_check(spec, e, st)
+        if fast is not None:
+            results[k] = jax_wgl._fast_result(spec, e, st, fast)
+            continue
         encs[k] = enc
         live.append(k)
     if not live:

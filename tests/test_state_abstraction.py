@@ -1,0 +1,70 @@
+"""State-abstraction invalidity pre-check tests: an ok op impossible
+from every reachable model state condemns the history at any scale."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker import jax_wgl, wgl
+from jepsen_tpu.models import cas_register_spec, register_spec
+from jepsen_tpu.simulate import random_history
+
+
+def test_impossible_read_10k_decided_instantly():
+    rng = random.Random(45100)
+    hist = random_history(rng, "cas-register", n_procs=64, n_ops=10_000,
+                          crash_p=0.01)
+    reads = [i for i, o in enumerate(hist)
+             if o["type"] == "ok" and o["f"] == "read"
+             and o.get("value") is not None]
+    hist[reads[len(reads) // 2]] = dict(hist[reads[len(reads) // 2]],
+                                        value=99)
+    e, st = cas_register_spec.encode(hist)
+    r = jax_wgl.check_encoded(cas_register_spec, e, st)
+    assert r["valid"] is False
+    assert r["engine"] == "aspect"
+    assert r["pattern"] == "impossible-from-every-state"
+    assert r["op"]["value"] == 99
+
+
+def test_no_false_claims_on_random_histories():
+    """The pre-check may only fire when the oracle agrees invalid."""
+    for seed in range(20):
+        rng = random.Random(seed)
+        hist = random_history(rng, "cas-register", n_procs=4, n_ops=24,
+                              crash_p=0.1)
+        e, st = cas_register_spec.encode(hist)
+        inv32, ret32, _ = jax_wgl._encode_arrays(e)
+        claim = jax_wgl._state_abstraction_check(cas_register_spec, e, st)
+        if claim is not None:
+            want = wgl.check_encoded(cas_register_spec, e, st)
+            assert want["valid"] is False, f"seed {seed}"
+
+
+def test_in_range_corruption_still_searched():
+    """A corrupted value that some state allows must go to the search,
+    and the search must still decide it."""
+    for seed in range(20):
+        rng = random.Random(seed)
+        hist = random_history(rng, "register", n_procs=3, n_ops=20,
+                              crash_p=0.0)
+        # make one read observe a written-somewhere but wrong-here value
+        reads = [i for i, o in enumerate(hist)
+                 if o["type"] == "ok" and o["f"] == "read"
+                 and o.get("value") is not None]
+        writes = sorted({o["value"] for o in hist if o["f"] == "write"})
+        if not reads or len(writes) < 2:
+            continue
+        i = reads[len(reads) // 2]
+        wrong = next(w for w in writes if w != hist[i]["value"])
+        bad = list(hist)
+        bad[i] = dict(bad[i], value=wrong)
+        e, st = register_spec.encode(bad)
+        # the pre-check must make no claim (the value IS reachable)
+        assert jax_wgl._state_abstraction_check(
+            register_spec, e, st) is None
+        r = jax_wgl.check_encoded(register_spec, e, st)
+        want = wgl.check_encoded(register_spec, e, st)
+        assert r["valid"] == want["valid"]
+        return
+    pytest.skip("no seed produced a corruptible history")

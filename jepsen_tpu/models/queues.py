@@ -279,6 +279,47 @@ def _unordered_step(state, f, args, ret, xp):
     return xp.sort(new_buf), ok
 
 
+def _unordered_fast_check(e, inv32, ret32):
+    """Bag (unordered queue) polynomial decision. Without FIFO order,
+    the only constraints are per-value: a dequeue of v needs an
+    enqueue of v that STARTED before the dequeue finished, each value
+    dequeued at most once, and nothing dequeued that was never
+    enqueued. That's exact for info-free complete histories; the
+    invalidity patterns are sound with info ops too (an observed info
+    enqueue definitely happened)."""
+    n = len(e)
+    if n == 0:
+        return True
+    f = np.asarray(e.f)
+    is_ok = np.asarray(e.is_ok, bool)
+    ok_deq = (f == F_DEQUEUE) & is_ok
+    if np.any(np.asarray(e.ret)[ok_deq, 0] == NIL):
+        return None
+    enq_of = {}
+    for i in np.flatnonzero(f == F_ENQUEUE):
+        v = int(e.args[i][0])
+        if v in enq_of:
+            return None   # duplicate values: out of scope
+        enq_of[v] = i
+    seen = set()
+    for i in np.flatnonzero(ok_deq):
+        v = int(e.ret[i][0])
+        if v in seen:
+            return False, {"op_index": int(i),
+                           "pattern": "double-dequeue"}
+        seen.add(v)
+        j = enq_of.get(v)
+        if j is None:
+            return False, {"op_index": int(i),
+                           "pattern": "dequeue-of-unknown-value"}
+        if ret32[i] < inv32[j]:
+            return False, {"op_index": int(i),
+                           "pattern": "dequeue-before-enqueue"}
+    if not bool((~is_ok).any()):
+        return True
+    return None
+
+
 unordered_queue_spec = register_model(ModelSpec(
     name="unordered-queue",
     f_codes={"enqueue": F_ENQUEUE, "dequeue": F_DEQUEUE},
@@ -289,4 +330,5 @@ unordered_queue_spec = register_model(ModelSpec(
     make_oracle=UnorderedQueue,
     encode_op=_queue_encode,
     pad_state=_pad_nil,
+    fast_check=_unordered_fast_check,
 ))

@@ -95,3 +95,29 @@ def test_aspect_invalid_carries_witness():
     # confirm runs the oracle over the same history
     r2 = jax_wgl.check_encoded(fifo_queue_spec, e, st, confirm=True)
     assert r2["confirmed"] is True
+
+
+def test_unordered_queue_fast_check_differential():
+    """The bag fast check must agree with the oracle wherever it
+    answers; FIFO-generated histories are valid bag histories too."""
+    from jepsen_tpu.models import unordered_queue_spec
+    from jepsen_tpu.models.queues import _unordered_fast_check
+    decided = 0
+    for seed in range(40):
+        rng = random.Random(seed)
+        crash = 0.0 if seed % 2 == 0 else 0.1
+        hist = random_history(rng, "fifo-queue", n_procs=4, n_ops=24,
+                              crash_p=crash)
+        if seed % 3 == 2:
+            hist = corrupt(rng, hist)
+        e, st = unordered_queue_spec.encode(hist)
+        inv32, ret32, _ = jax_wgl._encode_arrays(e)
+        fast = _unordered_fast_check(e, inv32, ret32)
+        if fast is None:
+            continue
+        if isinstance(fast, tuple):
+            fast = fast[0]
+        decided += 1
+        want = wgl.check_encoded(unordered_queue_spec, e, st)["valid"]
+        assert fast == want, f"seed {seed}: bag={fast} oracle={want}"
+    assert decided >= 15

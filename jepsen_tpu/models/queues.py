@@ -153,6 +153,41 @@ def _fifo_hint(e, inv32, ret32):
     return np.clip(pri, -(2 ** 31), 2 ** 31 - 1).astype(np.int32)
 
 
+def _per_value_scan(e, inv32, ret32):
+    """Shared queue/bag pattern scan. Returns (enq_of, deq_of, verdict):
+    verdict is None when the scan passes, a (False, witness) pair when a
+    per-value bad pattern fires, or "skip" when the history is out of
+    scope (unknown dequeue values, duplicate enqueue values)."""
+    f = np.asarray(e.f)
+    is_ok = np.asarray(e.is_ok, bool)
+    ok_deq = (f == F_DEQUEUE) & is_ok
+    if np.any(np.asarray(e.ret)[ok_deq, 0] == NIL):
+        return None, None, "skip"
+    enq_of = {}
+    for i in np.flatnonzero(f == F_ENQUEUE):
+        v = int(e.args[i][0])
+        if v in enq_of:
+            return None, None, "skip"
+        enq_of[v] = i
+    deq_of = {}
+    for i in np.flatnonzero(ok_deq):
+        v = int(e.ret[i][0])
+        if v in deq_of:
+            return None, None, (False, {"op_index": int(i),
+                                        "pattern": "double-dequeue"})
+        deq_of[v] = i
+        j = enq_of.get(v)
+        if j is None:
+            return None, None, (
+                False, {"op_index": int(i),
+                        "pattern": "dequeue-of-unknown-value"})
+        if ret32[i] < inv32[j]:
+            return None, None, (
+                False, {"op_index": int(i),
+                        "pattern": "dequeue-before-enqueue"})
+    return enq_of, deq_of, None
+
+
 def _fifo_fast_check(e, inv32, ret32):
     """Aspect-style polynomial decision for FIFO histories (after
     Henzinger/Sezgin/Vafeiadis-style bad patterns; values are unique and
@@ -178,31 +213,12 @@ def _fifo_fast_check(e, inv32, ret32):
         return True
     f = np.asarray(e.f)
     is_ok = np.asarray(e.is_ok, bool)
-    # this procedure assumes every dequeue's return value is known
     deq_mask = (f == F_DEQUEUE)
-    ok_deq = deq_mask & is_ok
-    if np.any(np.asarray(e.ret)[ok_deq, 0] == NIL):
+    enq_of, deq_of, status = _per_value_scan(e, inv32, ret32)
+    if status == "skip":
         return None
-    enq_of = {}
-    for i in np.flatnonzero(f == F_ENQUEUE):
-        v = int(e.args[i][0])
-        if v in enq_of:
-            return None    # duplicate enqueue values: out of scope
-        enq_of[v] = i
-    deq_of = {}
-    for i in np.flatnonzero(ok_deq):
-        v = int(e.ret[i][0])
-        if v in deq_of:
-            return False, {"op_index": int(i),
-                           "pattern": "double-dequeue"}
-        deq_of[v] = i
-        j = enq_of.get(v)
-        if j is None:
-            return False, {"op_index": int(i),
-                           "pattern": "dequeue-of-unknown-value"}
-        if ret32[i] < inv32[j]:
-            return False, {"op_index": int(i),
-                           "pattern": "dequeue-before-enqueue"}
+    if status is not None:
+        return status
     # (iii): order violations among dequeued values, vectorized
     vals = sorted(deq_of)
     if vals:
@@ -290,32 +306,12 @@ def _unordered_fast_check(e, inv32, ret32):
     n = len(e)
     if n == 0:
         return True
-    f = np.asarray(e.f)
-    is_ok = np.asarray(e.is_ok, bool)
-    ok_deq = (f == F_DEQUEUE) & is_ok
-    if np.any(np.asarray(e.ret)[ok_deq, 0] == NIL):
+    _, _, status = _per_value_scan(e, inv32, ret32)
+    if status == "skip":
         return None
-    enq_of = {}
-    for i in np.flatnonzero(f == F_ENQUEUE):
-        v = int(e.args[i][0])
-        if v in enq_of:
-            return None   # duplicate values: out of scope
-        enq_of[v] = i
-    seen = set()
-    for i in np.flatnonzero(ok_deq):
-        v = int(e.ret[i][0])
-        if v in seen:
-            return False, {"op_index": int(i),
-                           "pattern": "double-dequeue"}
-        seen.add(v)
-        j = enq_of.get(v)
-        if j is None:
-            return False, {"op_index": int(i),
-                           "pattern": "dequeue-of-unknown-value"}
-        if ret32[i] < inv32[j]:
-            return False, {"op_index": int(i),
-                           "pattern": "dequeue-before-enqueue"}
-    if not bool((~is_ok).any()):
+    if status is not None:
+        return status
+    if not bool((~np.asarray(e.is_ok, bool)).any()):
         return True
     return None
 

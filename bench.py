@@ -89,7 +89,7 @@ def main():
         if k % 8 == 7:
             hist = corrupt(rng, hist)
         hists.append(hist)
-    hist3 = random_history(rng, "mutex", n_procs=16, n_ops=2000,
+    hist3 = random_history(rng, "mutex", n_procs=64, n_ops=10_000,
                            crash_p=0.02)
     hist4 = random_history(rng, "fifo-queue", n_procs=6, n_ops=150,
                            crash_p=0.02)
@@ -137,8 +137,9 @@ def main():
     r3 = jax_wgl.check_encoded(mutex_spec, e3, st3, timeout_s=60)
     d3 = time.monotonic() - t0
     rungs["3-mutex"] = {
-        "ops": len(e3), "procs": 16,
+        "ops": len(e3), "procs": 64,
         "device_s": round(d3, 1), "device_valid": r3["valid"],
+        "device_iterations": r3.get("iterations"),
     }
 
     # -- rung 4: FIFO queue ----------------------------------------------

@@ -236,7 +236,6 @@ def _fifo_fast_check(e, inv32, ret32):
             return False, {"op_index": int(dj[bi]),
                            "pattern": "fifo-order-violation",
                            "enqueued-after": int(ej[ai])}
-    has_info = bool((~is_ok).any())
     no_info_deq = not bool((deq_mask & ~is_ok).any())
     # (iv): a stuck ahead of a dequeued b
     if no_info_deq and vals:
@@ -252,7 +251,13 @@ def _fifo_fast_check(e, inv32, ret32):
                 return False, {"op_index": int(dj[bi]),
                                "pattern": "dequeue-past-stuck-value",
                                "stuck-enqueue": int(ua[ai])}
-    if not has_info:
+    # Exactness needs only info DEQUEUES absent: a crashed enqueue is
+    # either observed (committed, with window [invoke, infinity) -- the
+    # pattern checks above already treat its return as infinite) or
+    # unobserved (never forced, never a pattern-iv stuck value: that set
+    # is filtered to ok enqueues). A crashed dequeue, by contrast, may
+    # have consumed an arbitrary value, which no pattern models.
+    if no_info_deq:
         return True
     return None
 
@@ -311,7 +316,11 @@ def _unordered_fast_check(e, inv32, ret32):
         return None
     if status is not None:
         return status
-    if not bool((~np.asarray(e.is_ok, bool)).any()):
+    f = np.asarray(e.f)
+    is_ok = np.asarray(e.is_ok, bool)
+    if not bool(((f == F_DEQUEUE) & ~is_ok).any()):
+        # crashed enqueues never block a bag verdict (observed ones are
+        # committed with open windows; unobserved ones are ignorable)
         return True
     return None
 

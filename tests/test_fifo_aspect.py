@@ -121,3 +121,25 @@ def test_unordered_queue_fast_check_differential():
         want = wgl.check_encoded(unordered_queue_spec, e, st)["valid"]
         assert fast == want, f"seed {seed}: bag={fast} oracle={want}"
     assert decided >= 15
+
+
+def test_crashed_enqueues_still_decided():
+    """Only info DEQUEUES block a definite verdict: a history whose sole
+    indeterminate ops are crashed enqueues decides exactly."""
+    from jepsen_tpu.models.queues import F_DEQUEUE
+    found = 0
+    for seed in range(200):
+        rng = random.Random(seed)
+        hist = random_history(rng, "fifo-queue", n_procs=4, n_ops=24,
+                              crash_p=0.1)
+        infos = [o for o in hist if o["type"] == "info"]
+        if not infos or any(o["f"] == "dequeue" for o in infos):
+            continue
+        found += 1
+        e, st, fast = _decide(hist)
+        assert fast is not None
+        want = wgl.check_encoded(fifo_queue_spec, e, st)["valid"]
+        assert fast == want, f"seed {seed}"
+        if found >= 5:
+            break
+    assert found >= 3

@@ -125,21 +125,24 @@ def test_unordered_queue_fast_check_differential():
 
 def test_crashed_enqueues_still_decided():
     """Only info DEQUEUES block a definite verdict: a history whose sole
-    indeterminate ops are crashed enqueues decides exactly."""
-    from jepsen_tpu.models.queues import F_DEQUEUE
-    found = 0
-    for seed in range(200):
+    indeterminate ops are crashed enqueues decides exactly -- in both
+    directions (corrupted variants cover the invalid side)."""
+    found = invalid_seen = 0
+    for seed in range(300):
         rng = random.Random(seed)
         hist = random_history(rng, "fifo-queue", n_procs=4, n_ops=24,
                               crash_p=0.1)
         infos = [o for o in hist if o["type"] == "info"]
         if not infos or any(o["f"] == "dequeue" for o in infos):
             continue
+        if seed % 2 == 1:
+            hist = corrupt(rng, hist)
         found += 1
         e, st, fast = _decide(hist)
         assert fast is not None
         want = wgl.check_encoded(fifo_queue_spec, e, st)["valid"]
         assert fast == want, f"seed {seed}"
-        if found >= 5:
+        invalid_seen += want is False
+        if found >= 10 and invalid_seen >= 2:
             break
-    assert found >= 3
+    assert found >= 5 and invalid_seen >= 1

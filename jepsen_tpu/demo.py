@@ -22,6 +22,7 @@ from . import independent
 from .checker import checkers as cks
 from .tests import bank as bank_workload
 from .tests import linearizable_register
+from .tests.cycle import append as append_workload
 
 
 class DemoState:
@@ -32,6 +33,7 @@ class DemoState:
         self.registers = {}
         self.balances = {}
         self.set = set()
+        self.lists = {}
 
 
 class DemoDB(jdb.DB):
@@ -42,6 +44,7 @@ class DemoDB(jdb.DB):
         with self.state.lock:
             self.state.registers.clear()
             self.state.set.clear()
+            self.state.lists.clear()
             accounts = test.get("accounts") or []
             total = test.get("total-amount") or 0
             if accounts:
@@ -178,6 +181,45 @@ def set_workload(opts, state):
             "generator": g}
 
 
+class AppendClient(jclient.Client):
+    """Transactional list-append over shared per-key lists. The
+    dirty-read bug occasionally reverses a read, which the cycle
+    checker flags as an incompatible order."""
+
+    def __init__(self, state, bug=None):
+        self.state = state
+        self.bug = bug
+        self._n = 0
+
+    def open(self, test, node):
+        return AppendClient(self.state, self.bug)
+
+    def invoke(self, test, op):
+        out = dict(op)
+        txn = []
+        with self.state.lock:
+            self._n += 1
+            for f, k, v in op["value"]:
+                if f == "append":
+                    self.state.lists.setdefault(k, []).append(v)
+                    txn.append([f, k, v])
+                else:
+                    got = list(self.state.lists.get(k, []))
+                    if self.bug == "dirty-read" and self._n % 7 == 0 \
+                            and len(got) >= 2:
+                        got = got[::-1]
+                    txn.append([f, k, got])
+        out.update(type="ok", value=txn)
+        return out
+
+
+def append_workload_fn(opts, state):
+    w = append_workload.test({"key-count": 3, "max-txn-length": 3})
+    return {**w,
+            "client": AppendClient(state, opts.get("bug")),
+            "generator": gen.clients(gen.stagger(0.001, w["generator"]))}
+
+
 def noop_workload(opts, state):
     return {"client": jclient.noop,
             "checker": cc.unbridled_optimism(),
@@ -189,6 +231,7 @@ WORKLOADS = {
     "register": register_workload,
     "bank": bank_workload_fn,
     "set": set_workload,
+    "append": append_workload_fn,
     "noop": noop_workload,
 }
 

@@ -129,3 +129,31 @@ def test_web_serve(tmp_path, monkeypatch):
         assert ei.value.code in (403, 404)
     finally:
         srv.shutdown()
+
+
+def test_demo_append_workload_clean(tmp_path, monkeypatch):
+    import random
+    from jepsen_tpu import core, demo, store
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+    random.seed(45100)
+    t = demo.demo_test({"nodes": ["n1", "n2"], "workload": "append",
+                        "concurrency": 4, "time-limit": 2})
+    done = core.run(t)
+    assert done["results"]["workload"]["valid"] is True
+    txns = [o for o in done["history"] if o.get("f") == "txn"]
+    assert txns
+
+
+def test_demo_append_workload_dirty_read_caught(tmp_path, monkeypatch):
+    import random
+    from jepsen_tpu import core, demo, store
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+    random.seed(45100)
+    t = demo.demo_test({"nodes": ["n1", "n2"], "workload": "append",
+                        "concurrency": 4, "time-limit": 2,
+                        "bug": "dirty-read"})
+    done = core.run(t)
+    res = done["results"]["workload"]
+    assert res["valid"] is not True
+    assert "incompatible-order" in res.get("anomaly_types", []) or \
+        res["valid"] == "unknown" or res["valid"] is False

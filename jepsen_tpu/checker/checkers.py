@@ -89,12 +89,36 @@ class Linearizable(Checker):
     may return "unknown" on overflow), or default "competition" (races
     all three; the first definite verdict wins)."""
 
-    def __init__(self, model, algorithm="competition", engine_opts=None):
+    def __init__(self, model, algorithm="competition", engine_opts=None,
+                 init_ops=None):
         assert model is not None, \
             "the linearizable checker requires a model"
         self.spec = mbase.model_spec(model)
         self.algorithm = algorithm
         self.engine_opts = engine_opts or {}
+        #: ops establishing the initial state, e.g. [{"f": "write",
+        #: "value": 0}] for a register pre-set to 0 (the reference's
+        #: (model/cas-register 0)). Prepended as already-completed pairs
+        #: before every real op.
+        self.init_ops = list(init_ops or [])
+
+    def prepare_history(self, client_hist):
+        """Prepend the init ops as already-completed pairs ordered before
+        every real op (negative indices). Both the direct check and
+        independent's batched per-key path go through this."""
+        if not self.init_ops:
+            return client_hist
+        lo = min((o.get("index", 0) for o in client_hist), default=0)
+        synth = []
+        for j, op in enumerate(self.init_ops):
+            base = lo - 2 * (len(self.init_ops) - j)
+            synth.append({"type": "invoke", "process": -1,
+                          "f": op["f"], "value": op.get("value"),
+                          "index": base, "time": base})
+            synth.append({"type": "ok", "process": -1,
+                          "f": op["f"], "value": op.get("value"),
+                          "index": base + 1, "time": base + 1})
+        return synth + client_hist
 
     def check(self, test, hist, opts=None):
         from . import jax_wgl, linear, wgl
@@ -102,6 +126,7 @@ class Linearizable(Checker):
                        if isinstance(o.get("process"), int)
                        or o.get("type") in ("invoke", "ok", "fail", "info")
                        and o.get("process") != "nemesis"]
+        client_hist = self.prepare_history(client_hist)
         e, init_state = self.spec.encode(client_hist)
         algo = self.algorithm
         if algo == "wgl":
@@ -206,7 +231,8 @@ def linearizable(opts):
     if isinstance(opts, dict):
         return Linearizable(opts["model"], opts.get("algorithm",
                                                     "competition"),
-                            opts.get("engine_opts"))
+                            opts.get("engine_opts"),
+                            opts.get("init-ops"))
     return Linearizable(opts)
 
 

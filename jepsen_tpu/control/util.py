@@ -64,13 +64,20 @@ def install_archive(url, dest, user=None):
     handles .tar.gz/.tgz/.zip, strips a single top-level directory."""
     archive = cached_wget(url)
     exec_("rm", "-rf", dest)
-    tmp = tmp_dir()
+    tmp = str(tmp_dir()).strip()
+    if not tmp or tmp == "/":
+        # NEVER proceed with a degenerate tmp path: the mv below would
+        # otherwise operate on / as root
+        raise RuntimeError(f"mktemp returned {tmp!r}")
     try:
         if url.endswith(".zip"):
             exec_("unzip", "-qq", archive, "-d", tmp)
         else:
             exec_("tar", "-xf", archive, "-C", tmp)
-        entries = exec_("ls", "-A", tmp).splitlines()
+        entries = [x for x in exec_("ls", "-A", tmp).splitlines()
+                   if x.strip()]
+        if not entries:
+            raise RuntimeError(f"archive extracted nothing: {url}")
         src = f"{tmp}/{entries[0]}" if len(entries) == 1 else tmp
         exec_("mkdir", "-p", dest)
         exec_("bash", "-c", f"mv {src}/* {dest}/")

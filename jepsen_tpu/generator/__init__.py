@@ -991,3 +991,37 @@ def flip_flop(a, b):
 def concat(*gens):
     """Chain arbitrary generators (generator.clj:776-781)."""
     return builtins.list(gens)
+
+
+@dataclass(frozen=True)
+class Cycle(Generator):
+    """Endless repetition of a SEQUENCE of generators: the chain
+    advances through its elements and restarts fresh when exhausted --
+    the analogue of driving a generator with Clojure's (cycle [...])
+    lazy seq (e.g. zookeeper.clj:121-124's sleep/start/sleep/stop
+    nemesis schedule). Contrast `repeat`, which never advances the
+    underlying generator and so re-emits its FIRST op forever."""
+
+    template: tuple
+    current: object = None
+
+    def op(self, test, ctx):
+        cur = self.current if self.current is not None \
+            else builtins.list(self.template)
+        res = gen_op(cur, test, ctx)
+        if res is None:
+            res = gen_op(builtins.list(self.template), test, ctx)
+            if res is None:   # template yields nothing at all
+                return None
+        op, g2 = res
+        return op, Cycle(self.template, g2)
+
+    def update(self, test, ctx, event):
+        if self.current is None:
+            return self
+        return Cycle(self.template,
+                     gen_update(self.current, test, ctx, event))
+
+
+def cycle(*gens):
+    return Cycle(tuple(gens))

@@ -310,7 +310,8 @@ class _IndependentChecker(Checker):
             for k in ks:
                 client = [o for o in subs[k]
                           if isinstance(o.get("process"), int)]
-                pairs.append(lin.spec.encode(client))
+                pairs.append(lin.spec.encode(
+                    lin.prepare_history(client)))
             batch = check_batch_encoded(lin.spec, pairs, **lin.engine_opts)
         except Exception:  # noqa: BLE001 - fall back to per-key path
             logger.warning("batched independent check failed; falling back",

@@ -1,0 +1,257 @@
+"""ZooKeeper test suite: the minimal single-file consumer (reference
+zookeeper/src/jepsen/zookeeper.clj, 137 LoC — the tutorial's target).
+
+A single compare-and-set register held in a znode, driven through the
+zkCli shell (no Python client dependency), a random-halves partitioner,
+and the device linearizability checker::
+
+    python -m jepsen_tpu.suites.zookeeper test \\
+        --node n1 --node n2 --node n3 --time-limit 15
+
+``--stub`` runs the whole pipeline against an in-memory register."""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from .. import checker as cc
+from .. import cli
+from .. import client as jclient
+from .. import control as c
+from .. import db as jdb
+from .. import generator as gen
+from .. import nemesis as jnemesis
+from .. import os as jos
+from .. import tests as tst
+from ..checker import checkers as cks
+from ..checker import perf as cperf
+from ..checker import timeline
+from ..os import debian
+
+#: needs >= 3.6: `get -s` / `set -v` grammar, and zkCli exiting nonzero
+#: on command errors (ZOOKEEPER-3482) -- both load-bearing for the client
+VERSION = "3.6.3"
+
+
+def zk_node_ids(test) -> dict:
+    """node name -> myid (zookeeper.clj:19-30)."""
+    return {node: i for i, node in enumerate(test["nodes"])}
+
+
+def zoo_cfg_servers(test) -> str:
+    """server.N lines for zoo.cfg (zookeeper.clj:32-38)."""
+    return "\n".join(f"server.{i}={node}:2888:3888"
+                     for node, i in zk_node_ids(test).items())
+
+
+ZOO_CFG = """tickTime=2000
+initLimit=10
+syncLimit=5
+dataDir=/var/lib/zookeeper
+clientPort=2181
+"""
+
+
+DIR = "/opt/zookeeper"
+
+
+class ZkDB(jdb.DB, jdb.LogFiles):
+    """Installs ZooKeeper from the release tarball and (re)configures the
+    ensemble (zookeeper.clj:40-72 uses the 3.4 distro package; the zkCli
+    grammar this suite's client needs ships with >= 3.6)."""
+
+    def __init__(self, version=VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        from ..control import util as cu
+        with c.su():
+            debian.install(["default-jre-headless"])
+            cu.install_archive(
+                f"https://archive.apache.org/dist/zookeeper/"
+                f"zookeeper-{self.version}/"
+                f"apache-zookeeper-{self.version}-bin.tar.gz", DIR)
+            c.exec_("mkdir", "-p", "/var/lib/zookeeper")
+            c.upload_string(str(zk_node_ids(test)[node]),
+                            "/var/lib/zookeeper/myid")
+            c.upload_string(ZOO_CFG + "\n" + zoo_cfg_servers(test),
+                            f"{DIR}/conf/zoo.cfg")
+            c.exec_(f"{DIR}/bin/zkServer.sh", "restart")
+
+    def teardown(self, test, node):
+        with c.su():
+            c.exec_star(f"{DIR}/bin/zkServer.sh", "stop")
+            c.exec_star("rm", "-rf", "/var/lib/zookeeper/version-2",
+                        f"{DIR}/logs")
+
+    def log_files(self, test, node):
+        return [f"{DIR}/logs/zookeeper.log"]
+
+
+# generators (zookeeper.clj:74-76)
+
+def r(test, ctx):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def w(test, ctx):
+    return {"type": "invoke", "f": "write", "value": random.randint(0, 4)}
+
+
+def cas(test, ctx):
+    return {"type": "invoke", "f": "cas",
+            "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+
+class ZkClient(jclient.Client):
+    """CAS register in the /jepsen znode via zkCli.sh on the node
+    (zookeeper.clj:78-104 uses avout; the shell round-trip keeps this
+    suite dependency-free). CAS uses the znode version for atomicity."""
+
+    ZKCLI = "/opt/zookeeper/bin/zkCli.sh"
+
+    def __init__(self, node=None):
+        self.node = node
+
+    def open(self, test, node):
+        cl = ZkClient(node)
+        return cl
+
+    def setup(self, test):
+        with c.on(self.node):
+            c.exec_star(self.ZKCLI, "create", "/jepsen", "0")
+
+    def _get(self):
+        out = c.exec_(self.ZKCLI, "get", "-s", "/jepsen")
+        lines = [ln.strip() for ln in str(out).splitlines()
+                 if ln.strip()]
+        # zkCli intersperses WATCHER::/WatchedEvent/log noise; the value
+        # is the line immediately before the stat block (cZxid = ...)
+        stat_at = next(i for i, ln in enumerate(lines)
+                       if ln.startswith("cZxid"))
+        value = int(lines[stat_at - 1])
+        version = next(int(ln.split("=")[-1].strip())
+                       for ln in lines if ln.startswith("dataVersion"))
+        return value, version
+
+    def invoke(self, test, op):
+        out_op = dict(op)
+        try:
+            with c.on(self.node):
+                if op["f"] == "read":
+                    value, _ = self._get()
+                    out_op.update(type="ok", value=value)
+                elif op["f"] == "write":
+                    c.exec_(self.ZKCLI, "set", "/jepsen",
+                            str(op["value"]))
+                    out_op["type"] = "ok"
+                else:
+                    old, new = op["value"]
+                    value, version = self._get()
+                    if value != old:
+                        out_op["type"] = "fail"
+                    else:
+                        # version-guarded set: loses cleanly when another
+                        # writer interleaved. zkCli >= 3.6 exits nonzero
+                        # on BadVersion (ZOOKEEPER-3482); the output
+                        # check is belt and braces.
+                        res = c.exec_star(self.ZKCLI, "set", "-v",
+                                          str(version), "/jepsen",
+                                          str(new))
+                        txt = str(res.get("out", "")) + \
+                            str(res.get("err", ""))
+                        if res.get("exit") != 0 or "BadVersion" in txt \
+                                or "version No is not valid" in txt:
+                            out_op["type"] = "fail"
+                        else:
+                            out_op["type"] = "ok"
+        except Exception as e:  # noqa: BLE001 - indeterminate
+            out_op.update(
+                type=("fail" if op["f"] == "read" else "info"),
+                error=repr(e))
+        return out_op
+
+
+class StubClient(jclient.Client):
+    """Shared in-memory register for --stub runs."""
+
+    def __init__(self, box=None, lock=None):
+        self.box = box if box is not None else {"v": 0}
+        self.lock = lock or threading.Lock()
+
+    def open(self, test, node):
+        return StubClient(self.box, self.lock)
+
+    def invoke(self, test, op):
+        out = dict(op)
+        with self.lock:
+            if op["f"] == "read":
+                out.update(type="ok", value=self.box["v"])
+            elif op["f"] == "write":
+                self.box["v"] = op["value"]
+                out["type"] = "ok"
+            else:
+                old, new = op["value"]
+                if self.box["v"] == old:
+                    self.box["v"] = new
+                    out["type"] = "ok"
+                else:
+                    out["type"] = "fail"
+        return out
+
+
+def zk_test(opts):
+    """Options map -> test map (zookeeper.clj:106-129)."""
+    stub = opts.get("stub")
+    test = dict(tst.noop_test())
+    test.update(opts)
+    test.update({
+        "name": "zookeeper",
+        "os": jos.noop if stub else debian.os,
+        "db": jdb.noop if stub else ZkDB(opts.get("version", VERSION)),
+        "client": StubClient() if stub else ZkClient(),
+        "nemesis": jnemesis.partition_random_halves(),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 15),
+            gen.nemesis(
+                gen.cycle(gen.sleep(5),
+                          {"type": "info", "f": "start"},
+                          gen.sleep(5),
+                          {"type": "info", "f": "stop"}),
+                gen.stagger(1, gen.mix([r, w, cas])))),
+        # perf + linearizable, like the reference (zookeeper.clj:127-129;
+        # no stats: sparse histories legitimately have zero ok cas ops)
+        "checker": cc.compose({
+            # the register starts at 0 (the znode is created with "0"):
+            # the reference's (model/cas-register 0)
+            "linear": cks.linearizable(
+                {"model": "cas-register",
+                 "algorithm": opts.get("algorithm", "competition"),
+                 "init-ops": [{"f": "write", "value": 0}]}),
+            "perf": cperf.perf(),
+            "timeline": timeline.html(),
+        }),
+    })
+    if stub:
+        test["ssh"] = {"dummy?": True}
+    return test
+
+
+def _opt_spec(parser):
+    parser.add_argument("--version", default=VERSION)
+    parser.add_argument("--algorithm", default="competition")
+    parser.add_argument("--stub", action="store_true",
+                        help="in-memory register + dummy remote")
+
+
+def main(argv=None):
+    cmds = {}
+    cmds.update(cli.single_test_cmd({"test-fn": zk_test,
+                                     "opt-spec": _opt_spec}))
+    cmds.update(cli.serve_cmd())
+    cli.run(cmds, argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -131,10 +131,10 @@ def test(opts):
         "generator": gen.time_limit(
             opts.get("time-limit", 60),
             gen.nemesis(
-                gen.repeat(gen.concat(gen.sleep(10),
-                                      {"type": "info", "f": "start"},
-                                      gen.sleep(10),
-                                      {"type": "info", "f": "stop"})),
+                gen.cycle(gen.sleep(10),
+                          {"type": "info", "f": "start"},
+                          gen.sleep(10),
+                          {"type": "info", "f": "stop"}),
                 gen.stagger(
                     1, independent.concurrent_generator(
                         1, itertools.count(),

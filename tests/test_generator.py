@@ -261,3 +261,20 @@ def test_generation_rate():
     dt = time.monotonic() - t0
     assert len(invocations(h)) == 20_000
     assert dt < 20, f"generator too slow: {20_000/dt:.0f} ops/s"
+
+def test_cycle_advances_and_restarts():
+    """cycle() drives a sequence to exhaustion and restarts it -- unlike
+    repeat(), which never advances the underlying generator (the
+    zookeeper-style sleep/start/sleep/stop schedule relies on this)."""
+    from jepsen_tpu.generator.testing import perfect, simulate
+    g = gen.limit(6, gen.cycle({"f": "a"}, {"f": "b"}, {"f": "c"}))
+    hist = simulate({"nodes": ["n1"], "concurrency": 1}, g, perfect)
+    fs = [o["f"] for o in hist if o["type"] == "invoke"]
+    assert fs == ["a", "b", "c", "a", "b", "c"]
+
+
+def test_cycle_empty_template_terminates():
+    from jepsen_tpu.generator.testing import perfect, simulate
+    hist = simulate({"nodes": ["n1"], "concurrency": 1},
+                    gen.cycle(), perfect)
+    assert hist == []

@@ -1,0 +1,62 @@
+"""ZooKeeper minimal suite tests (reference zookeeper.clj, the tutorial
+target): stub end-to-end with partitions, and the DB/client command
+streams on the dummy remote."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu import core, store
+from jepsen_tpu.suites import zookeeper as zk
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+
+
+def test_zoo_cfg_and_node_ids():
+    test = {"nodes": ["n1", "n2", "n3"]}
+    assert zk.zk_node_ids(test) == {"n1": 0, "n2": 1, "n3": 2}
+    cfg = zk.zoo_cfg_servers(test)
+    assert "server.0=n1:2888:3888" in cfg and "server.2=n3:2888:3888" in cfg
+
+
+def test_stub_end_to_end_with_partitions():
+    random.seed(45100)
+    t = zk.zk_test({"nodes": ["n1", "n2", "n3"], "stub": True,
+                    "concurrency": 6, "time-limit": 7})
+    done = core.run(t)
+    res = done["results"]
+    assert res["linear"]["valid"] is True
+    nem_fs = {o["f"] for o in done["history"]
+              if o.get("process") == "nemesis"}
+    assert "start" in nem_fs
+    cmds = [cmd for _, cmd in done.get("dummy-log", [])]
+    assert any("iptables" in x for x in cmds)
+
+
+def test_db_setup_command_stream():
+    test = {"nodes": ["n1", "n2"], "ssh": {"dummy?": True}}
+    db = zk.ZkDB()
+    with c.ssh_scope(test), c.on("n2"):
+        with pytest.raises(RuntimeError,
+                           match="mktemp returned|extracted nothing"):
+            # the dummy remote's empty `ls` output must ABORT the
+            # install, never degenerate to `mv /*`
+            db.setup(test, "n2")
+        db.teardown(test, "n2")
+    cmds = [cmd for _, cmd in test["dummy-log"]]
+    assert any("wget-cache" in x for x in cmds)     # tarball fetch path
+    assert not any("mv /*" in x for x in cmds)
+    assert any("zkServer.sh stop" in x for x in cmds)
+
+
+def test_cli_main_stub():
+    random.seed(45100)
+    with pytest.raises(SystemExit) as exc:
+        zk.main(["test", "--stub", "--node", "n1", "--node", "n2",
+                 "--time-limit", "2", "--concurrency", "4"])
+    assert exc.value.code == 0
+    assert store.latest()["results"]["valid"] is True

@@ -57,7 +57,14 @@ class OracleRace:
         out = {"valid": "unknown", "error": "timeout",
                "s": min(budget_s, time.monotonic() - self.t0)}
         try:
-            got = self.q.get_nowait()
+            # a process that exited cleanly has a result, but it may still
+            # be in the queue's pipe buffer right after join(): block
+            # briefly rather than misreport a near-deadline finish as a
+            # timeout
+            if self.p.exitcode == 0:
+                got = self.q.get(timeout=5)
+            else:
+                got = self.q.get_nowait()
             out.update(got)
             out.pop("error", None)
         except Exception:  # noqa: BLE001 - empty queue = still running

@@ -7,7 +7,9 @@ Appends are unique per key, so every observed list is a *trace* of the
 key's version history:
 
 - version order per key  = the longest observed read (all reads must be
-  prefix-compatible or the history is immediately invalid)
+  prefix-compatible or the history is immediately invalid), extended
+  past the last read via within-txn append adjacency (a txn's
+  consecutive appends to one key are adjacent versions)
 - WW  A -> B   when B appended the element right after A's in the order
 - WR  A -> R   when R's (external) read of k ends in A's element
 - RW  R -> B   when B appended the element right after the last one R saw
@@ -72,6 +74,7 @@ def analyze(history, anomalies=("G0", "G1c", "G-single", "G2")) -> dict:
     # txns; failed/info appends tracked for G1a / indeterminacy
     writer = {}
     intermediate = {}
+    txn_succ = {}
     for op in oks:
         per_key = {}
         for k, v in _appends(_txn(op)):
@@ -80,6 +83,10 @@ def analyze(history, anomalies=("G0", "G1c", "G-single", "G2")) -> dict:
         for k, vs in per_key.items():
             for v in vs[:-1]:
                 intermediate[(k, v)] = op
+            # txns are atomic, so a txn's consecutive appends to one key
+            # are *adjacent* in the key's version order
+            for v1, v2 in zip(vs, vs[1:]):
+                txn_succ.setdefault(k, {})[v1] = v2
     failed_writer = {}
     for op in fails:
         for k, v in _appends(_txn(op)):
@@ -107,7 +114,35 @@ def analyze(history, anomalies=("G0", "G1c", "G-single", "G2")) -> dict:
                 note("incompatible-order",
                      {"key": k, "read": lst, "longest": longest,
                       "op": dict(op)})
-        version_order[k] = longest
+        version_order[k] = list(longest)
+
+    # extend each order past the last read using within-txn adjacency, so
+    # tail appends no read observed still contribute WW/RW edges. Residual
+    # gap vs elle: append chains never touching the observed prefix stay
+    # unordered and contribute no edges (documented incompleteness; no
+    # false positives either way).
+    for k, order in version_order.items():
+        succ = txn_succ.get(k, {})
+        seen = set(order)
+        while order and order[-1] in succ and succ[order[-1]] not in seen:
+            nxt = succ[order[-1]]
+            order.append(nxt)
+            seen.add(nxt)
+        # adjacency that contradicts the observed order is an anomaly in
+        # its own right: v2 must sit directly after v1, so either it's
+        # elsewhere in the order, or v1 has a non-final position while v2
+        # was never observed at all
+        pos = {v: i for i, v in enumerate(order)}
+        for v1, v2 in succ.items():
+            if v1 not in pos:
+                continue
+            nxt_pos = pos.get(v2)
+            bad = (nxt_pos != pos[v1] + 1 if nxt_pos is not None
+                   else pos[v1] < len(order) - 1)
+            if bad:
+                note("incompatible-order",
+                     {"key": k, "txn-adjacent": [v1, v2],
+                      "observed": order})
 
     graph = Graph(len(oks))
 

@@ -157,6 +157,7 @@ class Nemesis(NemesisProto):
                 return
 
     def setup(self, test):
+        self._threads = []
         self._swap(initial_fields)
         self._running.set()
         self._stop.clear()
@@ -171,10 +172,16 @@ class Nemesis(NemesisProto):
         return self
 
     def invoke(self, test, op):
-        done = self.box["state"].invoke(test, op)
-        self._swap(lambda s: resolve(
-            s.assoc(pending=s.pending | {(_freeze(op), _freeze(done))}),
-            test, self.opts))
+        # read + invoke + record under one lock hold: a poller swap
+        # between the read and the pending-set update would make the
+        # invoke run against a stale view (the lock is not reentrant, so
+        # this inlines _swap rather than calling it)
+        with self._lock:
+            done = self.box["state"].invoke(test, op)
+            s = self.box["state"]
+            self.box["state"] = resolve(
+                s.assoc(pending=s.pending | {(_freeze(op), _freeze(done))}),
+                test, self.opts)
         return done
 
     def teardown(self, test):

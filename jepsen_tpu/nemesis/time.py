@@ -177,26 +177,27 @@ def _exp_delta_ms(rng=random):
     return int(rand_nth([-1, 1], rng) * math.pow(2, 2 + rng.random() * 16))
 
 
-def bump_gen_select(select):
+def bump_gen_select(select, rng=random):
     def gen(test, ctx):
         return {"type": "info", "f": "bump",
-                "value": {n: _exp_delta_ms() for n in select(test)}}
+                "value": {n: _exp_delta_ms(rng) for n in select(test)}}
     return gen
 
 
 bump_gen = bump_gen_select(_random_nodes)
 
 
-def strobe_gen_select(select):
+def strobe_gen_select(select, rng=random):
     """Strobes of 4 ms..262 s delta, 1 ms..1 s period, 0-32 s duration
-    (time.clj:179-192)."""
+    (time.clj:179-192). ``rng`` is injectable like the other clock
+    generators, so strobe schedules seed consistently."""
     def gen(test, ctx):
         return {"type": "info", "f": "strobe",
                 "value": {n: {"delta": int(math.pow(2,
-                                                    2 + random.random() * 16)),
+                                                    2 + rng.random() * 16)),
                               "period": int(math.pow(2,
-                                                     random.random() * 10)),
-                              "duration": random.random() * 32}
+                                                     rng.random() * 10)),
+                              "duration": rng.random() * 32}
                           for n in select(test)}}
     return gen
 

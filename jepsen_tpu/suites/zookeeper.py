@@ -126,11 +126,20 @@ class ZkClient(jclient.Client):
         out = c.exec_(self.ZKCLI, "get", "-s", "/jepsen")
         lines = [ln.strip() for ln in str(out).splitlines()
                  if ln.strip()]
-        # zkCli intersperses WATCHER::/WatchedEvent/log noise; the value
-        # is the line immediately before the stat block (cZxid = ...)
+        # zkCli intersperses WATCHER::/WatchedEvent/log noise; with
+        # `get -s` the value is everything before the first stat field
+        # (cZxid = ...). This suite only ever writes small integers, so
+        # the last pre-stat line must parse as one -- anything else is a
+        # parse failure we surface explicitly rather than mis-read.
         stat_at = next(i for i, ln in enumerate(lines)
                        if ln.startswith("cZxid"))
-        value = int(lines[stat_at - 1])
+        raw = lines[stat_at - 1] if stat_at > 0 else ""
+        try:
+            value = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"unparseable znode value {raw!r} before stat block "
+                f"(suite writes only integers; zkCli noise?)") from None
         version = next(int(ln.split("=")[-1].strip())
                        for ln in lines if ln.startswith("dataVersion"))
         return value, version

@@ -161,6 +161,36 @@ def test_append_g1b_intermediate_read():
     assert "G1b" in res["anomaly_types"]
 
 
+def test_append_txn_adjacency_extends_version_order():
+    # T0's second append was never read, but within-txn adjacency extends
+    # the version order past the longest read, so T1's read of [1] gains
+    # an RW edge to T0 and the WR+RW pair classifies as G-single (on top
+    # of the G1b intermediate read)
+    hist = H([["append", "x", 1], ["append", "x", 2]],
+             [["r", "x", [1]]])
+    res = ap.analyze(hist)
+    assert "G-single" in res["anomaly_types"]
+    assert "G1b" in res["anomaly_types"]
+
+
+def test_append_txn_adjacency_conflict_is_incompatible_order():
+    # a read order that contradicts within-txn append adjacency
+    hist = H([["append", "x", 1], ["append", "x", 2]],
+             [["r", "x", [2, 1]]])
+    res = ap.analyze(hist)
+    assert "incompatible-order" in res["anomaly_types"]
+
+
+def test_append_txn_adjacency_midorder_conflict():
+    # T0 atomically appends [1,2]; T2 appends 3; a read observed [1,3]:
+    # no serial order can put 3 between 1 and its adjacent successor 2
+    hist = H([["append", "x", 1], ["append", "x", 2]],
+             [["append", "x", 3]],
+             [["r", "x", [1, 3]]])
+    res = ap.analyze(hist)
+    assert "incompatible-order" in res["anomaly_types"]
+
+
 def test_append_incompatible_order():
     hist = H([["r", "x", [1, 2]]],
              [["r", "x", [2, 1]]],

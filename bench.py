@@ -160,8 +160,8 @@ def main():
         "engine": r4.get("engine"),
     }
 
-    # rung 4b: info-free FIFO at 25x the search's reach -- decided by
-    # the exact aspect (bad-pattern) fast path
+    # rung 4b: info-free FIFO at 25x the old search's reach -- decided
+    # by the exact aspect (bad-pattern) fast path
     hist4b = random_history(rng, "fifo-queue", n_procs=16, n_ops=5000,
                             crash_p=0.0)
     e4b, st4b = fifo_queue_spec.encode(hist4b)
@@ -171,6 +171,48 @@ def main():
         "ops": len(e4b), "procs": 16,
         "device_s": round(time.monotonic() - t0, 2),
         "device_valid": r4b["valid"], "engine": r4b.get("engine"),
+    }
+
+    # rung 4c: 10k-op FIFO with ~500 crashed ops INCLUDING info
+    # dequeues, decided exactly by the round-3 closure+matching aspect
+    # (round 2 punted all info-dequeue histories to the search, which
+    # capped out near 200 ops)
+    hist4c = random_history(rng, "fifo-queue", n_procs=64, n_ops=10_000,
+                            crash_p=0.05)
+    e4c, st4c = fifo_queue_spec.encode(hist4c)
+    t0 = time.monotonic()
+    r4c = jax_wgl.check_encoded(fifo_queue_spec, e4c, st4c)
+    rungs["4c-fifo-info-10k"] = {
+        "ops": len(e4c), "procs": 64,
+        "infos": int((~e4c.is_ok).sum()),
+        "info_dequeues": sum(1 for o in hist4c if o["type"] == "info"
+                             and o["f"] == "dequeue"),
+        "device_s": round(time.monotonic() - t0, 2),
+        "device_valid": r4c["valid"], "engine": r4c.get("engine"),
+    }
+
+    # rung 4d: the SEARCH engine itself (fast path disabled) on a
+    # 2k-op info-dequeue-bearing FIFO history: the witness-order hint +
+    # junk-enqueue prune let the greedy rollout walk an explicit
+    # linearization, so the B&B decides in a handful of iterations
+    # where round 2's kernel capped out near 200 ops
+    import dataclasses
+    forced = dataclasses.replace(fifo_queue_spec, fast_check=None)
+    hist4d = random_history(rng, "fifo-queue", n_procs=16, n_ops=2000,
+                            crash_p=0.05)
+    e4d, st4d = forced.encode(hist4d)
+    t0 = time.monotonic()
+    r4d = jax_wgl.check_encoded(forced, e4d, st4d, timeout_s=60)
+    d4d = time.monotonic() - t0
+    assert r4d.get("engine") == "jax-wgl", r4d
+    rungs["4d-fifo-info-search-2k"] = {
+        "ops": len(e4d), "procs": 16,
+        "infos": int((~e4d.is_ok).sum()),
+        "device_s": round(d4d, 2), "device_valid": r4d["valid"],
+        "engine": r4d.get("engine"),
+        "device_iterations": r4d.get("iterations"),
+        "search_goal_met": bool(r4d["valid"] in (True, False)
+                                and d4d < 60),
     }
 
     # -- rung 5: the stretch goal ----------------------------------------
@@ -190,8 +232,12 @@ def main():
     # total added wall time <= one 60 s budget
     oracles = {"3": OracleRace("mutex", hist3),
                "4": OracleRace("fifo-queue", hist4),
+               "4c": OracleRace("fifo-queue", hist4c),
+               "4d": OracleRace("fifo-queue", hist4d),
                "5": OracleRace("cas-register", hist5)}
     for key, rung in (("3", "3-mutex"), ("4", "4-fifo-queue"),
+                      ("4c", "4c-fifo-info-10k"),
+                      ("4d", "4d-fifo-info-search-2k"),
                       ("5", "5-cas-10k-64proc")):
         o = oracles[key].result()
         rungs[rung]["cpu_s"] = round(o["s"], 1)

@@ -303,10 +303,25 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
         # chain configs are pushed (deepest on top) and deduped like any
         # others, so backtracking still explores alternatives around any
         # step the greedy choice got wrong.
-        seed_ok = running & (bd >= 0)
-        seed_lin = jnp.take_along_axis(lin2k, bi[:, None, None],
+        # Seed from the DFS-TOP child: the deepest popped parent's best-
+        # priority surviving child -- exactly what plain DFS would pop
+        # next (parents are popped in w-ascending = shallowest-first
+        # order, candidates in c-ascending = priority order). Seeding
+        # from argmax-depth instead ties toward the FIRST max-depth
+        # lane, i.e. some shallow parent's plateau child, whose state
+        # wedges the chain immediately on brittle models (FIFO: an
+        # equal-depth config with the wrong queue contents is a dead
+        # end; measured as the chain advancing ~1 level/iteration).
+        dfs_rank = (arange_W[:, None] * C
+                    + (C - 1 - arange_C)[None, :]).reshape(M)   # (M,)
+        score = jnp.where(child_valid.reshape(K, M),
+                          dfs_rank[None, :], -1)
+        sbi = jnp.argmax(score, axis=1)                        # (K,)
+        seed_ok = running & (jnp.take_along_axis(
+            score, sbi[:, None], axis=1)[:, 0] >= 0)
+        seed_lin = jnp.take_along_axis(lin2k, sbi[:, None, None],
                                        axis=1)[:, 0]          # (K,B)
-        seed_st = jnp.take_along_axis(st2k, bi[:, None, None],
+        seed_st = jnp.take_along_axis(st2k, sbi[:, None, None],
                                       axis=1)[:, 0]           # (K,S)
 
         def roll_step(rc_, _):
@@ -630,6 +645,24 @@ def _fast_result(spec, e, init_state, fast, confirm=False):
     return result
 
 
+def _apply_prune(spec, e, inv32, ret32):
+    """Apply the model's validity-preserving candidate prune (if any):
+    dropped rows get the padding-row treatment (invoke just below INF so
+    they are never candidates while any ok op is outstanding, return at
+    INF so they never constrain the WGL rule). Pruning only ever removes
+    non-ok ops, so the success condition is untouched."""
+    if spec.prune is None:
+        return inv32, ret32
+    keep = spec.prune(e, inv32, ret32)
+    if keep is None:
+        return inv32, ret32
+    keep = np.asarray(keep, bool)
+    assert not np.any(~keep & np.asarray(e.is_ok, bool)), \
+        "prune must never drop ok ops"
+    return (np.where(keep, inv32, INF32 - 1).astype(np.int32),
+            np.where(keep, ret32, INF32).astype(np.int32))
+
+
 def _priority_order(spec, e, inv32, ret32):
     """Renumber ops into linearization-priority order: argsort by the
     model hint (default: earliest deadline / return index). The kernel
@@ -685,6 +718,7 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
         fast = _state_abstraction_check(spec, e, init_state)
         if fast is not None:
             return _fast_result(spec, e, init_state, fast, confirm)
+    inv32, ret32 = _apply_prune(spec, e, inv32, ret32)
     C = max_point_concurrency(inv32, np.where(ret32 == INF32,
                                               INF_TIME, ret32.astype(np.int64)))
     A = int(e.args.shape[1]) if e.args.ndim == 2 else 1

@@ -128,6 +128,13 @@ class ModelSpec:
     # aspect-style bad-pattern detection, which scales where the NP-hard
     # search cannot.
     fast_check: Callable = None
+    # optional fn(e, invoke32, ret32) -> bool[n] keep mask | None: ops
+    # whose mask is False are removed from the search's candidate set
+    # entirely. Must be validity-preserving BOTH ways (the check with and
+    # without the pruned ops must agree) -- only provably-droppable
+    # non-ok ops qualify (e.g. crashed enqueues of never-observed
+    # values). None = no pruning applies to this history.
+    prune: Callable = None
 
     def encode(self, hist):
         """Encode an event history for this model. Returns (EncodedHistory,

@@ -113,7 +113,8 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         if fast is not None:
             results[k] = jax_wgl._fast_result(spec, e, st, fast)
             continue
-        encs[k] = enc
+        inv32, ret32 = jax_wgl._apply_prune(spec, e, enc[0], enc[1])
+        encs[k] = (inv32, ret32, enc[2])
         live.append(k)
     if not live:
         return results

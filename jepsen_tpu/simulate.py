@@ -61,7 +61,7 @@ def random_history(rng: random.Random, spec_name: str, n_procs: int,
                 return False, None
             state["locked"] = False
             return True, None
-    elif spec_name == "fifo-queue":
+    elif spec_name in ("fifo-queue", "unordered-queue"):
         state = {"q": [], "next": 0}
 
         def gen_invoke(p):
@@ -75,7 +75,9 @@ def random_history(rng: random.Random, spec_name: str, n_procs: int,
                 state["q"].append(inv["value"])
                 return True, inv["value"]
             if state["q"]:
-                return True, state["q"].pop(0)
+                i = (0 if spec_name == "fifo-queue"
+                     else rng.randrange(len(state["q"])))
+                return True, state["q"].pop(i)
             return False, None
     else:
         raise ValueError(f"unknown spec {spec_name!r}")

@@ -60,8 +60,10 @@ from ..history import INF_TIME
 
 INF32 = np.int32(2**31 - 1)
 
-#: linear-probe length for the dedup hash table
-PROBES = 8
+#: linear-probe length for the dedup hash table (4 keeps the probe
+#: gather -- the kernel's dominant cost, see PROFILE.md -- half the
+#: width of the original 8 at no measured dedup-quality cost)
+PROBES = 4
 
 
 # ---------------------------------------------------------------------------
@@ -116,7 +118,12 @@ RUNNING, VALID = np.int32(0), np.int32(1)
 
 #: carry tuple element indices with a per-key leading axis (the rest are
 #: shared per table-group); the batch checker's compaction gathers these
-KEYED = (0, 1, 2, 5, 6, 7, 8, 9, 10, 11)
+KEYED = (0, 1, 2, 4, 5, 6, 7, 8, 9, 10)
+
+#: version tag hashed into checkpoint fingerprints: bump whenever the
+#: carry layout or table format changes, so snapshots from an older
+#: build are cleanly ignored instead of crashing the resume
+CARRY_LAYOUT = f"carry-v3:tab-interleaved,probes{PROBES}"
 
 
 @functools.lru_cache(maxsize=64)
@@ -135,7 +142,10 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
     iteration, which dominated runtime.
 
     Carry layout (see KEYED): buf_lin (K,O,B) u32, buf_state (K,O,S) i32,
-    top (K,) i32, tab1/tab2 (G,T) u32 shared, dropped (K,) bool, status (K,)
+    top (K,) i32, tab (G,T,2) u32 shared (h1/h2 fingerprint pairs
+    interleaved so one gather fetches both words -- the two separate
+    tables cost a second 590k-row gather per iteration, the kernel's
+    single biggest op), dropped (K,) bool, status (K,)
     i32, explored (K,) i32, best_depth (K,) i32, best_lin (K,B) u32,
     best_state (K,S) i32, its (K,) i32, it (G,) i32, claim (G,Tc) i32
     shared. G is the table-group count: 1 locally; under shard_map over a
@@ -157,9 +167,12 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
     if R is None:
         # Greedy-rollout chain length per iteration. Each rollout step is
         # a handful of tiny sequential device ops, so the chain only pays
-        # for itself on histories deep enough that advancing R levels per
-        # iteration beats plain branch-and-bound; short histories skip it.
-        R = 0 if n <= 256 else min(256, n)
+        # for itself once advancing R levels per iteration beats plain
+        # branch-and-bound; only trivially short histories skip it.
+        # (Round 2 used a 256-op cutoff, which left the multi-key batch
+        # -- 200-op histories per key -- grinding one depth level per
+        # iteration; lowering it to 64 cut rung 2 device time ~3x.)
+        R = 0 if n <= 64 else min(256, n)
     ML = M + R
     KML = K * ML
     Tc = 1 << 16   # twin-claim scratch; fixed so carries are W-independent
@@ -191,9 +204,9 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
         return h1, h2
 
     def body(carry, consts):
-        (buf_lin, buf_state, top, tab1g, tab2g, dropped, status, explored,
+        (buf_lin, buf_state, top, tabg, dropped, status, explored,
          best_depth, best_lin, best_state, its, it, claimg) = carry
-        tab1, tab2, claim = tab1g[0], tab2g[0], claimg[0]
+        tab, claim = tabg[0], claimg[0]
         invoke, ret, fop, args, rets, ok_words, salt, bound = consts
         running = (status == RUNNING) & (top > 0)             # (K,)
 
@@ -273,22 +286,31 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
             VALID, status)
 
         # -- witness tracking ----------------------------------------------
+        # Row selection is a first-occurrence one-hot + masked SUM, not
+        # argmax + take_along_axis: the per-key gathers lowered to
+        # serialized scalar-memory fusions costing ~15 ms/iteration at
+        # K=256 (profiled; see PROFILE.md), the masked reduction is a
+        # plain vector op.
         depth = lax.population_count(lin2 & okw).sum(axis=-1) \
             .astype(jnp.int32)
         depth = jnp.where(child_valid, depth, -1).reshape(K, M)
-        bi = jnp.argmax(depth, axis=1)                        # (K,)
-        bd = jnp.take_along_axis(depth, bi[:, None], axis=1)[:, 0]
+        bd = jnp.max(depth, axis=1)                           # (K,)
         better = bd > best_depth
         best_depth = jnp.where(better, bd, best_depth)
         lin2k = lin2.reshape(K, M, B)
         st2k = st2.reshape(K, M, S)
+        eq = depth == bd[:, None]
+        pick = (eq & (jnp.cumsum(eq.astype(jnp.int32), axis=1) == 1)
+                & better[:, None])                            # (K,M)
         best_lin = jnp.where(
             better[:, None],
-            jnp.take_along_axis(lin2k, bi[:, None, None], axis=1)[:, 0],
+            jnp.sum(jnp.where(pick[..., None], lin2k, 0), axis=1,
+                    dtype=jnp.uint32),
             best_lin)
         best_state = jnp.where(
             better[:, None],
-            jnp.take_along_axis(st2k, bi[:, None, None], axis=1)[:, 0],
+            jnp.sum(jnp.where(pick[..., None], st2k, 0), axis=1,
+                    dtype=jnp.int32),
             best_state)
 
         # -- greedy rollout -------------------------------------------------
@@ -316,13 +338,15 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
                     + (C - 1 - arange_C)[None, :]).reshape(M)   # (M,)
         score = jnp.where(child_valid.reshape(K, M),
                           dfs_rank[None, :], -1)
-        sbi = jnp.argmax(score, axis=1)                        # (K,)
-        seed_ok = running & (jnp.take_along_axis(
-            score, sbi[:, None], axis=1)[:, 0] >= 0)
-        seed_lin = jnp.take_along_axis(lin2k, sbi[:, None, None],
-                                       axis=1)[:, 0]          # (K,B)
-        seed_st = jnp.take_along_axis(st2k, sbi[:, None, None],
-                                      axis=1)[:, 0]           # (K,S)
+        smax = jnp.max(score, axis=1)                          # (K,)
+        seed_ok = running & (smax >= 0)
+        seq = score == smax[:, None]
+        spick = seq & (jnp.cumsum(seq.astype(jnp.int32), axis=1) == 1) \
+            & seed_ok[:, None]                                 # (K,M)
+        seed_lin = jnp.sum(jnp.where(spick[..., None], lin2k, 0),
+                           axis=1, dtype=jnp.uint32)          # (K,B)
+        seed_st = jnp.sum(jnp.where(spick[..., None], st2k, 0),
+                          axis=1, dtype=jnp.int32)            # (K,S)
 
         def roll_step(rc_, _):
             lin_r, st_r, alive = rc_
@@ -366,20 +390,21 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
                 lax.population_count(ch_lin & okw2).sum(-1)
                 .astype(jnp.int32),
                 -1)                                           # (K,R)
-            cbi = jnp.argmax(ch_depth, axis=1)
-            cbd = jnp.take_along_axis(ch_depth, cbi[:, None],
-                                      axis=1)[:, 0]
+            cbd = jnp.max(ch_depth, axis=1)
             cbetter = cbd > best_depth
             best_depth = jnp.where(cbetter, cbd, best_depth)
+            ceq = ch_depth == cbd[:, None]
+            cpick = (ceq & (jnp.cumsum(ceq.astype(jnp.int32), axis=1)
+                            == 1) & cbetter[:, None])         # (K,R)
             best_lin = jnp.where(
                 cbetter[:, None],
-                jnp.take_along_axis(ch_lin, cbi[:, None, None],
-                                    axis=1)[:, 0],
+                jnp.sum(jnp.where(cpick[..., None], ch_lin, 0), axis=1,
+                        dtype=jnp.uint32),
                 best_lin)
             best_state = jnp.where(
                 cbetter[:, None],
-                jnp.take_along_axis(ch_st, cbi[:, None, None],
-                                    axis=1)[:, 0],
+                jnp.sum(jnp.where(cpick[..., None], ch_st, 0), axis=1,
+                        dtype=jnp.int32),
                 best_state)
 
         # -- combined lanes (expansion then chain, natural order) -----------
@@ -430,8 +455,8 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
         slots = (slot0[:, None]
                  + jnp.arange(PROBES, dtype=jnp.int32)[None, :]) & (T - 1)
         slots = jnp.where((cv & ~dup)[:, None], slots, T)
-        cur1 = tab1.at[slots].get(mode="fill", fill_value=0)   # (KM,P)
-        cur2 = tab2.at[slots].get(mode="fill", fill_value=0)
+        cur = tab.at[slots].get(mode="fill", fill_value=0)   # (KM,P,2)
+        cur1, cur2 = cur[..., 0], cur[..., 1]
         seen = ((cur1 == h1[:, None]) & (cur2 == h2[:, None])).any(axis=1) \
             & cv & ~dup
         empty = (cur1 == 0) & (cur2 == 0)
@@ -440,8 +465,8 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
                                     axis=1)[:, 0]
         want = cv & ~dup & ~seen & empty.any(axis=1)
         wslot = jnp.where(want, islot, T)
-        tab1 = tab1.at[wslot].set(h1, mode="drop")
-        tab2 = tab2.at[wslot].set(h2, mode="drop")
+        tab = tab.at[wslot].set(jnp.stack([h1, h2], axis=-1),
+                                mode="drop")
 
         # -- push fresh configs (per-key positions, one flat scatter) -------
         # Stack order (ascending position = popped sooner next time):
@@ -484,7 +509,7 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
                                                    dtype=jnp.int32), 0)
         its = its + running.astype(jnp.int32)
         it = it + 1
-        return (buf_lin, buf_state, top, tab1[None], tab2[None], dropped,
+        return (buf_lin, buf_state, top, tab[None], dropped,
                 status, explored, best_depth, best_lin, best_state, its,
                 it, claim[None])
 
@@ -493,7 +518,7 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
         buf_state = jnp.zeros((K, O, S), jnp.int32) \
             .at[:, 0, :].set(init_states)
         return (buf_lin, buf_state, jnp.ones(K, jnp.int32),
-                jnp.zeros((G, T), jnp.uint32), jnp.zeros((G, T), jnp.uint32),
+                jnp.zeros((G, T, 2), jnp.uint32),
                 jnp.zeros(K, bool), jnp.full(K, RUNNING),
                 jnp.zeros(K, jnp.int32),
                 jnp.full(K, -1, jnp.int32), jnp.zeros((K, B), jnp.uint32),
@@ -512,8 +537,8 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
         consts = (invoke, ret, fop, args, rets, ok_words, salt, bound)
 
         def cond(c):
-            return jnp.any((c[6] == RUNNING) & (c[2] > 0)) \
-                & (c[12][0] < bound)
+            return jnp.any((c[5] == RUNNING) & (c[2] > 0)) \
+                & (c[11][0] < bound)
 
         return lax.while_loop(cond, lambda c: body(c, consts), carry)
 
@@ -749,7 +774,10 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
 
     B, W, O, T = _plan_sizes(n_pad, S, C, frontier_width, stack_size,
                              table_size)
-    max_iters = max(64, max_configs // W)
+    # honor tiny explicit budgets (a 1-iteration run must bail after 1
+    # iteration, not 64 -- the checkpoint tests rely on it); the default
+    # 50M-config budget keeps max_iters far above any real search
+    max_iters = max(1, max_configs // W)
 
     init_carry, run_chunk = _build_search(spec.step, 1, n_pad, B, S, C, A,
                                           W, O, T)
@@ -763,6 +791,7 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     if checkpoint is not None:
         import hashlib
         h = hashlib.sha256()
+        h.update(CARRY_LAYOUT.encode())
         h.update(spec.name.encode())
         for a in (inv32, ret32, fop, args, rets, ok_words, init_state,
                   np.asarray([n_pad, B, S, C, W, O, T], np.int64)):
@@ -782,12 +811,12 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     t0 = _time.monotonic()
     last_ckpt = t0
     timed_out = False
-    it = int(carry[12][0])
+    it = int(carry[11][0])
     while True:
         bound = min(it + chunk_iters, max_iters)
         carry = run_chunk(carry, *consts, jnp.int32(bound))
-        status, top, it = (int(carry[6][0]), int(carry[2][0]),
-                           int(carry[12][0]))
+        status, top, it = (int(carry[5][0]), int(carry[2][0]),
+                           int(carry[11][0]))
         if status != RUNNING or top == 0 or it >= max_iters:
             break
         now = _time.monotonic()
@@ -802,10 +831,10 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
                 _save_checkpoint(checkpoint, fingerprint, carry)
             break
 
-    out = {"status": carry[6][0], "top": carry[2][0],
-           "dropped": carry[5][0], "explored": carry[7][0],
-           "iterations": carry[11][0], "best_depth": carry[8][0],
-           "best_lin": carry[9][0], "best_state": carry[10][0]}
+    out = {"status": carry[5][0], "top": carry[2][0],
+           "dropped": carry[4][0], "explored": carry[6][0],
+           "iterations": carry[10][0], "best_depth": carry[7][0],
+           "best_lin": carry[8][0], "best_state": carry[9][0]}
     out = jax.device_get(out)
     if timed_out and int(out["status"]) == RUNNING and int(out["top"]) > 0:
         return {"valid": "unknown", "error": "timeout",

@@ -359,7 +359,13 @@ def _fifo_plan(e, inv32, ret32, want_plan=False):
         return None, None
     if status is not None:
         return status, None
-    # (iii): order violations among dequeued values, vectorized
+    # (iii): order violations among dequeued values. A violating pair
+    # (a, b) has enq(a) really-before enq(b) and deq(b) really-before
+    # deq(a): for each a that is "is the earliest dequeue-completion,
+    # among values whose enqueue began after a's enqueue returned,
+    # before a's dequeue was invoked?" -- a suffix-min sweep over the
+    # enqueue-invocation order, O(V log V) (the naive V x V boolean
+    # matrices melt past ~50k dequeued values).
     vals = sorted(deq_of)
     ei_sorted = dr_sorted = dj_sorted = None
     if vals:
@@ -369,19 +375,25 @@ def _fifo_plan(e, inv32, ret32, want_plan=False):
         enq_inv = inv32[ej].astype(np.int64)
         deq_ret = ret32[dj].astype(np.int64)
         deq_inv = inv32[dj].astype(np.int64)
-        a_before_b = enq_ret[:, None] < enq_inv[None, :]
-        db_before_da = deq_ret[None, :] < deq_inv[:, None]
-        bad = a_before_b & db_before_da
-        if np.any(bad):
-            ai, bi = np.argwhere(bad)[0]
-            return (False, {"op_index": int(dj[bi]),
-                            "pattern": "fifo-order-violation",
-                            "enqueued-after": int(ej[ai])}), None
         order = np.argsort(enq_inv)
         ei_sorted = enq_inv[order]
         dr_sorted = deq_ret[order]
         dj_sorted = dj[order]
         suffix_min = np.minimum.accumulate(dr_sorted[::-1])[::-1]
+        pos3 = np.searchsorted(ei_sorted, enq_ret, side="right")
+        smin = np.where(pos3 < len(ei_sorted),
+                        suffix_min[np.minimum(pos3, len(ei_sorted) - 1)],
+                        _FAR)
+        bad_a = smin < deq_inv
+        if np.any(bad_a):
+            ai = int(np.argmax(bad_a))
+            k = int(pos3[ai])
+            bi = int(np.argmin(dr_sorted[k:])) + k
+            return (False, {"op_index": int(dj[ai]),
+                            "pattern": "fifo-order-violation",
+                            "enqueued-after": int(ej[ai]),
+                            "overtaking-dequeue": int(dj_sorted[bi])}), \
+                None
     # (iv) generalized: stuck values (ok-enqueued, never ok-dequeued)
     stuck_idx = np.asarray(
         sorted(enq_of[v] for v in enq_of

@@ -22,6 +22,7 @@ the per-key frontier as the batch shrinks to keep the chip busy.
 
 from __future__ import annotations
 
+import logging
 import time as _time
 
 import numpy as np
@@ -34,6 +35,8 @@ from ..checker.jax_wgl import (INF32, KEYED, RUNNING, _bucket, _build_search,
                                _encode_arrays, _plan_sizes,
                                max_point_concurrency)
 from ..history import INF_TIME
+
+logger = logging.getLogger(__name__)
 
 
 def _pad_key(e, init_state, spec, n_pad, S_pad, A, enc=None):
@@ -74,7 +77,7 @@ def _dummy_key(n_pad, S_pad, A):
             None)
 
 
-def _shard_specs(mesh, n_carry=14, n_consts=8):
+def _shard_specs(mesh, n_carry=13, n_consts=8):
     from jax.sharding import PartitionSpec as P
     ax = mesh.axis_names[0]
     carry_specs = tuple(P(ax) for _ in range(n_carry))
@@ -144,7 +147,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     if frontier_width is None:
         W = max(32, min(W, 4096 // _bucket(n_live, 1)))
     O = max(4096, O // _bucket(min(n_live, 8), 1))
-    max_iters = max(64, max_configs // (W * n_live))
+    max_iters = max(1, max_configs // (W * n_live))
 
     cols = [_pad_key(pairs[k][0], pairs[k][1], spec, n_pad, S_pad, A,
                      encs[k])
@@ -205,10 +208,10 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     it = 0
 
     def harvest(rows, carry):
-        fields = {"status": carry[6], "top": carry[2], "dropped": carry[5],
-                  "explored": carry[7], "iterations": carry[11],
-                  "best_depth": carry[8], "best_lin": carry[9],
-                  "best_state": carry[10]}
+        fields = {"status": carry[5], "top": carry[2], "dropped": carry[4],
+                  "explored": carry[6], "iterations": carry[10],
+                  "best_depth": carry[7], "best_lin": carry[8],
+                  "best_state": carry[9]}
         got = jax.device_get(fields)
         for r in rows:
             if alive[r] >= 0:
@@ -216,12 +219,26 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
                                        for k, v in got.items()}
 
     while True:
-        bound = min(it + chunk_iters, max_iters)
+        # per-iteration cost scales with the live batch width, so chunk
+        # granularity must shrink as K grows or the whole run completes
+        # inside ONE dispatch and compaction never fires (measured at
+        # K=256: a single 256-iteration chunk ate 23 s, with 25
+        # exhaustion-proof stragglers dragging 231 finished keys'
+        # lanes the whole way)
+        eff_chunk = max(4, chunk_iters * 8 // max(16, len(alive)))
+        bound = min(it + eff_chunk, max_iters)
+        t_chunk = _time.monotonic()
         carry = run_b(carry, *consts, jnp.int32(bound))
         it = bound
-        status = np.asarray(carry[6])
+        status = np.asarray(carry[5])
+        if logger.isEnabledFor(logging.DEBUG):
+            logger.debug(
+                "chunk to it=%d: %.3fs, K=%d running=%d", it,
+                _time.monotonic() - t_chunk, len(alive),
+                int(((status == RUNNING) & (np.asarray(carry[2]) > 0)
+                     ).sum()))
         top = np.asarray(carry[2])
-        its = np.asarray(carry[11])
+        its = np.asarray(carry[10])
         running = (status == RUNNING) & (top > 0) & (its < max_iters)
         n_run = int(running.sum())
         if n_run == 0:

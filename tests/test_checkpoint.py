@@ -1,7 +1,7 @@
 import random, os
 from jepsen_tpu.checker import jax_wgl
 from jepsen_tpu.models import cas_register_spec
-from jepsen_tpu.simulate import random_history
+from jepsen_tpu.simulate import corrupt, random_history
 
 
 def test_checkpoint_resume(tmp_path):
@@ -43,7 +43,9 @@ def test_checkpoint_kept_on_budget_exhaustion(tmp_path):
     """An undecided max-configs run keeps its snapshot so a bigger-budget
     rerun resumes instead of restarting."""
     rng = random.Random(2)
-    hist = random_history(rng, "cas-register", 6, 120, 0.05)
+    # corrupt: the rollout cannot decide an invalid history in one
+    # iteration, so the tiny budget genuinely exhausts
+    hist = corrupt(rng, random_history(rng, "cas-register", 6, 120, 0.05))
     e, st = cas_register_spec.encode(hist)
     ck = str(tmp_path / "frontier.npz")
     r1 = jax_wgl.check_encoded(cas_register_spec, e, st, chunk_iters=1,
@@ -58,8 +60,16 @@ def test_checkpoint_kept_on_budget_exhaustion(tmp_path):
 
 def test_checkpoint_of_other_check_preserved(tmp_path):
     """A run pointed at another check's snapshot must not destroy it."""
-    rng = random.Random(3)
-    h1 = random_history(rng, "cas-register", 6, 120, 0.05)
+    rng = random.Random(4)
+    # corrupt: an undecided-after-one-iteration run is what leaves a
+    # snapshot behind (valid histories now decide via the rollout).
+    # Clamp the corrupted read back into the written 0-3 range so the
+    # state-abstraction pre-check can't decide it without searching.
+    h1 = corrupt(rng, random_history(rng, "cas-register", 6, 120, 0.05))
+    for o in h1:
+        if o["type"] == "ok" and o["f"] == "read" \
+                and o.get("value") is not None:
+            o["value"] = o["value"] % 4
     h2 = random_history(rng, "cas-register", 4, 40, 0.0)
     e1, st1 = cas_register_spec.encode(h1)
     e2, st2 = cas_register_spec.encode(h2)

@@ -114,7 +114,11 @@ def test_basic_cas():
 
     oks = [o for o in hist if h.ok(o)]
     reads = [o for o in oks if o["f"] == "read"]
-    assert reads[0]["value"] == 0   # first read sees db setup state
+    # a crashed phase-1 worker would turn the barrier read into :info and
+    # make reads[0] a phase-2 read; surface that case explicitly (seen
+    # once as a bare "4 == 0" under full-suite load)
+    infos = [o for o in hist if o["type"] == "info"]
+    assert reads[0]["value"] == 0, (reads[0], infos[:3])
 
     assert len(hist) == 2 * (n + 1)
     assert {o["f"] for o in hist} == {"read", "write", "cas"}

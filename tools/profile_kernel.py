@@ -1,0 +1,108 @@
+"""Capture and summarize a jax.profiler trace of the WGL search kernel.
+
+Reproduces the numbers in PROFILE.md: runs a rung-2-style multi-key batch
+(or rung-5 single key with --rung 5) under ``jax.profiler.trace``, then
+parses the TensorBoard trace JSON into a per-op device-time table with
+HLO source attribution (the trace events carry ``source`` args pointing
+at jax_wgl.py lines, which is how the round-3 bottlenecks were found).
+
+Usage::
+
+    python tools/profile_kernel.py [--rung 2|5] [--keys 256] [--out DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(out_dir, rung, keys):
+    import jax
+
+    from jepsen_tpu.checker import jax_wgl
+    from jepsen_tpu.models import cas_register_spec
+    from jepsen_tpu.parallel import check_batch_encoded
+    from jepsen_tpu.simulate import corrupt, random_history
+
+    spec = cas_register_spec
+    rng = random.Random(45100)
+    if rung == 2:
+        hists = []
+        for k in range(keys):
+            h = random_history(rng, "cas-register", n_procs=8, n_ops=200,
+                               crash_p=0.02)
+            hists.append(corrupt(rng, h) if k % 8 == 7 else h)
+        pairs = [spec.encode(h) for h in hists]
+        check_batch_encoded(spec, pairs)          # compile warmup
+        with jax.profiler.trace(out_dir):
+            check_batch_encoded(spec, pairs)
+    else:
+        hist = random_history(rng, "cas-register", n_procs=64,
+                              n_ops=10_000, crash_p=0.05)
+        e, st = spec.encode(hist)
+        jax_wgl.check_encoded(spec, e, st)        # compile warmup
+        with jax.profiler.trace(out_dir):
+            jax_wgl.check_encoded(spec, e, st)
+
+
+def summarize(out_dir, top=15):
+    paths = sorted(glob.glob(
+        os.path.join(out_dir, "plugins/profile/*/*.trace.json.gz")))
+    if not paths:
+        raise SystemExit(f"no trace under {out_dir}")
+    with gzip.open(paths[-1]) as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    pids = {ev["pid"]: ev["args"].get("name", "")
+            for ev in events
+            if ev.get("ph") == "M" and ev.get("name") == "process_name"}
+    dev = {p for p, name in pids.items() if "TPU" in name or "GPU" in name}
+    tot, cnt, src = (collections.Counter(), collections.Counter(), {})
+    span = [None, None]
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("pid") not in dev:
+            continue
+        name, dur = ev["name"], ev.get("dur", 0)
+        tot[name] += dur
+        cnt[name] += 1
+        if name not in src and ev.get("args", {}).get("source"):
+            src[name] = ev["args"]["source"]
+        ts = ev["ts"]
+        span[0] = ts if span[0] is None else min(span[0], ts)
+        span[1] = ts + dur if span[1] is None else max(span[1], ts + dur)
+    # top-level jit spans nest everything; report leaves only
+    leaves = {n: d for n, d in tot.items()
+              if not n.startswith(("jit_", "while."))}
+    wall = (span[1] - span[0]) / 1e6 if span[0] is not None else 0.0
+    print(f"trace: {paths[-1]}")
+    print(f"device span: {wall:.3f}s; leaf-op busy: "
+          f"{sum(leaves.values()) / 1e6:.3f}s")
+    print(f"{'total_s':>9} {'calls':>7}  {'op':<22} source")
+    for name, d in sorted(leaves.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"{d / 1e6:9.3f} {cnt[name]:7d}  {name:<22} "
+              f"{src.get(name, '')}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rung", type=int, default=2, choices=(2, 5))
+    ap.add_argument("--keys", type=int, default=256)
+    ap.add_argument("--out", default="/tmp/jepsen_tpu_profile")
+    ap.add_argument("--parse-only", action="store_true")
+    args = ap.parse_args()
+    if not args.parse_only:
+        capture(args.out, args.rung, args.keys)
+    summarize(args.out)
+
+
+if __name__ == "__main__":
+    main()

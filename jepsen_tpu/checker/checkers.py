@@ -138,9 +138,12 @@ class Linearizable(Checker):
                                       **self.engine_opts)
         else:
             a = self._competition(e, init_state)
-        # truncate heavyweight fields (checker.clj:213-216)
-        if "final_ops" in a:
-            a["final_ops"] = a["final_ops"][:10]
+        # truncate heavyweight fields (checker.clj:213-216: "writing
+        # these can take *hours*"): at most 10 paths / 10 configs
+        if "final_paths" in a:
+            a["final_paths"] = a["final_paths"][:10]
+        if "configs" in a:
+            a["configs"] = a["configs"][:10]
         if a.get("valid") is False:
             # render the failure witness like the reference's linear.svg
             # (checker.clj:206-212); never let plotting break the verdict

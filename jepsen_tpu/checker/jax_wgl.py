@@ -919,7 +919,7 @@ def _interpret(spec, e, out, max_iters, confirm, init_state, perm=None):
     dropped = bool(out["dropped"])
     if exhausted and not dropped:
         result["valid"] = False
-        _attach_witness(result, e, out, perm)
+        _attach_witness(result, e, out, perm, spec, init_state)
         if confirm:
             from . import wgl
             oracle = wgl.check_encoded(spec, e, init_state)
@@ -932,9 +932,10 @@ def _interpret(spec, e, out, max_iters, confirm, init_state, perm=None):
     return result
 
 
-def _attach_witness(result, e, out, perm=None):
-    """Decode the deepest stuck configuration into reference-style
-    :op / :final-paths info. Bit positions are in priority-sorted space;
+def _attach_witness(result, e, out, perm, spec, init_state):
+    """Decode the deepest stuck configuration into knossos-style
+    witness fields (op / final_paths / previous_ok / configs, see
+    checker/witness.py). Bit positions are in priority-sorted space;
     perm maps them back to original op indices."""
     lin = np.asarray(out["best_lin"], np.uint32)
     n = len(e)
@@ -942,14 +943,9 @@ def _attach_witness(result, e, out, perm=None):
     for i in range(n):
         pos = int(perm[i]) if perm is not None else i
         linearized[pos] = bool((lin[i // 32] >> np.uint32(i % 32)) & 1)
-    stuck = [i for i in range(n) if e.is_ok[i] and not linearized[i]]
-    if stuck:
-        i = stuck[0]
-        if e.ops is not None:
-            inv, comp = e.ops[i]
-            result["op"] = dict(comp if comp is not None else inv)
-        result["final_state"] = np.asarray(out["best_state"]).tolist()
-        result["linearized_ok_ops"] = int(out["best_depth"])
+    from . import witness
+    witness.attach(result, spec, e, linearized,
+                   np.asarray(out["best_state"]), init_state)
 
 
 def check_history(spec, history, **kw):

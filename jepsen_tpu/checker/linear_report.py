@@ -109,14 +109,19 @@ def render_analysis(test, history, analysis, opts=None):
         xs = [t / 1e9 for p_ in chosen for t in interval(*p_)]
         ax.set_xlim(min(xs), max(xs) * 1.02 + 1e-6)
         ax.set_xlabel("Time (s)")
-        states = [w.get("state") for w in
-                  (analysis.get("final_ops") or [])[:4]
-                  if isinstance(w, dict)]
+        states = [c.get("model") for c in
+                  (analysis.get("configs") or [])[:4]
+                  if isinstance(c, dict) and c.get("model") is not None]
         title = (f"{test.get('name', 'test')}: not linearizable — "
                  f"stuck before {op.get('f')} {op.get('value')!r} "
                  f"(process {op.get('process')})")
         if states:
             title += f"\nreachable model states: {states}"
+        prev = analysis.get("previous_ok")
+        if prev:
+            title += (f"\nlast linearized ok op: {prev.get('f')} "
+                      f"{prev.get('value')!r} "
+                      f"(process {prev.get('process')})")
         ax.set_title(title, fontsize=8)
         fig.tight_layout()
         fig.savefig(path, dpi=120)

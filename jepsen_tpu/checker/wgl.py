@@ -38,8 +38,8 @@ def check_encoded(spec, e, init_state, max_configs=None, cancel=None):
     Returns a result dict:
       valid: True | False
       configs_explored: number of distinct configurations visited
-      op / final_ops: on failure, the op(s) the search got stuck before
-        (row indices into e, decoded into event dicts when e.ops is set).
+      op / final_paths / previous_ok / configs: on failure, the
+        knossos-style witness fields (see checker/witness.py).
     """
     n = len(e)
     invoke = e.invoke_idx
@@ -113,26 +113,22 @@ def check_encoded(spec, e, init_state, max_configs=None, cancel=None):
         if not progressed and depth == best_depth and len(best_configs) < 8:
             best_configs.append((lin, state))
 
-    # exhausted: not linearizable; decode a witness
+    # exhausted: not linearizable; decode knossos-style witnesses
+    # (op / final_paths / previous_ok / configs -- see checker/witness.py)
     result = {"valid": False, "configs_explored": explored}
-    witnesses = []
-    for lin, state in best_configs:
-        unlin = full & ~lin & ok_mask
-        if unlin:
-            i = (unlin & -unlin).bit_length() - 1
-            witnesses.append({"row": i, "state": state.tolist(),
-                              "op": _decode_op(e, i)})
-    if witnesses:
-        result["op"] = witnesses[0]["op"]
-        result["final_ops"] = witnesses
+    if best_configs:
+        from . import witness
+        lin0, state0 = best_configs[0]
+        linearized = np.zeros(n, bool)
+        for i in range(n):
+            linearized[i] = bool((lin0 >> i) & 1)
+        witness.attach(result, spec, e, linearized, state0, init_state)
+        # the oracle tracks several distinct deepest configs; report the
+        # extras' model states alongside the fully-decoded primary one
+        for _lin, state in best_configs[1:]:
+            result["configs"].append(
+                {"model": witness._decode_state(spec, state)})
     return result
-
-
-def _decode_op(e, i):
-    if e.ops is not None and i < len(e.ops):
-        inv, comp = e.ops[i]
-        return dict(comp if comp is not None else inv)
-    return {"row": int(i)}
 
 
 def check_history(spec, history, **kw):

@@ -1,0 +1,211 @@
+"""knossos-parity failure witnesses for the linearizability engines.
+
+knossos.wgl/analysis returns, for an invalid history, ``{:valid? false,
+:op, :previous-ok, :configs, :final-paths}`` — a step-by-step path of
+``{:op, :model}`` entries to the deepest configuration, the last ok op on
+it, and the stuck configurations with their pending candidates. Jepsen
+truncates and persists these (reference checker.clj:206-216). The device
+engine tracks only the deepest configuration's (linearized-bitset, model
+state), so this module reconstructs the rest on host:
+
+* ``final_path`` replays the linearized SET into a legal WGL ORDER
+  (depth-first over set members under the real eligibility rule, guided
+  by the model's linearization-priority hint) and records the model
+  state after every step.
+* ``attach`` shapes the knossos-style fields onto a result dict:
+  ``final_paths`` (list of paths of ``{"op", "model"}``),
+  ``previous_ok`` (last ok op on the first path), and ``configs``
+  (``{"model", "last_op", "pending"}`` for the stuck configuration,
+  pending = ok/info ops that were WGL-eligible when the search wedged).
+
+Both engines (the sequential oracle and the device search) share this
+code, so their failure artifacts are interchangeable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..history import INF_TIME
+
+#: keep at most this many trailing steps per reported path (a 10k-op
+#: history's full path would dominate results.json; the tail is where
+#: the search got stuck, which is the part a human reads)
+PATH_TAIL = 100
+
+#: step-attempt budget for the replay DFS; the linearized set came from
+#: a real search path so the hint-guided replay almost never backtracks,
+#: but an adversarial set could force exponential work
+REPLAY_BUDGET = 500_000
+
+
+class _RetMin:
+    """Segment tree over return indices: global min with O(log n)
+    activate/deactivate, for the WGL eligibility rule under DFS
+    backtracking."""
+
+    def __init__(self, rets):
+        n = max(1, len(rets))
+        size = 1
+        while size < n:
+            size *= 2
+        self.size = size
+        self.t = np.full(2 * size, INF_TIME, np.int64)
+        self.t[size:size + len(rets)] = rets
+        for i in range(size - 1, 0, -1):
+            self.t[i] = min(self.t[2 * i], self.t[2 * i + 1])
+        self.rets = np.asarray(rets, np.int64)
+
+    def set_active(self, i, active):
+        j = self.size + i
+        self.t[j] = self.rets[i] if active else INF_TIME
+        j //= 2
+        while j:
+            self.t[j] = min(self.t[2 * j], self.t[2 * j + 1])
+            j //= 2
+
+    def min(self):
+        return self.t[1]
+
+
+def final_path(spec, e, linearized, init_state, budget=REPLAY_BUDGET):
+    """Order the linearized op set into a legal WGL step sequence.
+
+    ``linearized``: bool[n] over ``e``'s rows. Returns a list of
+    ``(row_index, state_after)`` or None if the replay budget runs out
+    (the witness then stays set-only)."""
+    n = len(e)
+    member = np.asarray(linearized, bool)
+    total = int(member.sum())
+    if total == 0:
+        return []
+    invoke = np.asarray(e.invoke_idx, np.int64)
+    rets = np.asarray(e.return_idx, np.int64)
+    f = np.asarray(e.f)
+    args = np.asarray(e.args).reshape(n, -1)
+    rvals = np.asarray(e.ret).reshape(n, -1)
+
+    # candidate order: the model's search hint (same priority the engine
+    # used), so the replay follows the search's own footsteps
+    if spec.hint is not None:
+        from .jax_wgl import _encode_arrays
+        inv32, ret32, _ = _encode_arrays(e)
+        pri = np.asarray(spec.hint(e, inv32, ret32), np.int64)
+    else:
+        pri = rets
+    members = sorted(np.flatnonzero(member).tolist(),
+                     key=lambda i: (pri[i], i))
+
+    tree = _RetMin(rets)
+
+    # doubly-linked list over member positions so each DFS level only
+    # scans still-undone members (a flat rescan is quadratic in path
+    # length); position `total` is the sentinel head/tail
+    head = total
+    nxt = list(range(1, total + 1)) + [0]      # nxt[head] = 0
+    prv = [head] + list(range(total))          # prv[head] = total - 1
+
+    def remove(j):
+        nxt[prv[j]] = nxt[j]
+        prv[nxt[j]] = prv[j]
+
+    def restore(j):
+        nxt[prv[j]] = j
+        prv[nxt[j]] = j
+
+    path = []                 # (row, state_after)
+    states = [np.asarray(init_state, np.int32)]
+    scan = [nxt[head]]        # per-level next list position to try
+    work = budget
+    while True:
+        if len(path) == total:
+            return path
+        j = scan[-1]
+        state = states[-1]
+        taken = False
+        while j != head:
+            work -= 1
+            if work < 0:
+                return None
+            i = members[j]
+            if invoke[i] < tree.min():
+                st2, ok = spec.step(state, f[i], args[i], rvals[i], np)
+                if bool(ok):
+                    st2 = np.asarray(st2, np.int32)
+                    tree.set_active(i, False)
+                    remove(j)
+                    path.append((i, st2))
+                    states.append(st2)
+                    scan[-1] = j          # resume point on backtrack
+                    scan.append(nxt[head])
+                    taken = True
+                    break
+            j = nxt[j]
+        if not taken:
+            scan.pop()
+            states.pop()
+            if not path:
+                return None
+            i, _ = path.pop()
+            jprev = scan[-1]
+            restore(jprev)
+            tree.set_active(i, True)
+            scan[-1] = nxt[jprev]
+
+
+def _decode_op(e, i):
+    if e.ops is not None and i < len(e.ops):
+        inv, comp = e.ops[i]
+        return dict(comp if comp is not None else inv)
+    return {"row": int(i)}
+
+
+def _decode_state(spec, state):
+    state = np.asarray(state)
+    if spec.decode_state is not None:
+        try:
+            return spec.decode_state(state)
+        except Exception:  # noqa: BLE001 - padding etc: fall through
+            pass
+    return state.tolist()
+
+
+def attach(result, spec, e, linearized, best_state, init_state):
+    """Shape knossos-style witness fields onto ``result`` (mutates and
+    returns it). ``linearized``: bool[n] of the deepest configuration."""
+    n = len(e)
+    linearized = np.asarray(linearized, bool)
+    is_ok = np.asarray(e.is_ok, bool)
+    stuck = np.flatnonzero(is_ok & ~linearized)
+    if len(stuck):
+        result["op"] = _decode_op(e, int(stuck[0]))
+    result["final_state"] = _decode_state(spec, best_state)
+    result["linearized_ok_ops"] = int((linearized & is_ok).sum())
+
+    path = final_path(spec, e, linearized, init_state)
+    if path is not None:
+        tail = path[-PATH_TAIL:]
+        steps = [{"op": _decode_op(e, i),
+                  "model": _decode_state(spec, st)} for i, st in tail]
+        result["final_paths"] = [steps]
+        if len(path) > len(tail):
+            result["final_paths_truncated_steps"] = len(path) - len(tail)
+        result["previous_ok"] = next(
+            (_decode_op(e, i) for i, _ in reversed(path) if e.is_ok[i]),
+            None)
+
+    # the stuck configuration: pending = ops still open under the WGL
+    # rule at the deepest config (invoked before every unlinearized
+    # return)
+    rets = np.asarray(e.return_idx, np.int64)
+    invoke = np.asarray(e.invoke_idx, np.int64)
+    un = ~linearized
+    rmin = rets[un].min() if un.any() else INF_TIME
+    pending = np.flatnonzero(un & (invoke < rmin))
+    result["configs"] = [{
+        "model": _decode_state(spec, best_state),
+        "last_op": (_decode_op(e, path[-1][0])
+                    if path else None),
+        "pending": [_decode_op(e, int(i)) for i in pending[:16]],
+    }]
+    return result

@@ -128,6 +128,10 @@ class ModelSpec:
     # aspect-style bad-pattern detection, which scales where the NP-hard
     # search cannot.
     fast_check: Callable = None
+    # optional fn(state_vec) -> jsonable: human-readable rendering of a
+    # state vector for failure witnesses (knossos shows e.g.
+    # #knossos.model.CASRegister{:value 3}); None = raw int list
+    decode_state: Callable = None
     # optional fn(e, invoke32, ret32) -> bool[n] keep mask | None: ops
     # whose mask is False are removed from the search's candidate set
     # entirely. Must be validity-preserving BOTH ways (the check with and

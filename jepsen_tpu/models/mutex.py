@@ -54,6 +54,7 @@ mutex_spec = register_model(ModelSpec(
     arg_width=1,
     state_size=lambda e: 1,
     init_state=lambda e, s: np.zeros(1, np.int32),
+    decode_state=lambda st: {"locked": bool(st[0])},
     step=_mutex_step,
     make_oracle=Mutex,
     encode_op=_mutex_encode,

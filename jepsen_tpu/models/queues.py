@@ -391,7 +391,7 @@ def _fifo_plan(e, inv32, ret32, want_plan=False):
             bi = int(np.argmin(dr_sorted[k:])) + k
             return (False, {"op_index": int(dj[ai]),
                             "pattern": "fifo-order-violation",
-                            "enqueued-after": int(ej[ai]),
+                            "own-enqueue": int(ej[ai]),
                             "overtaking-dequeue": int(dj_sorted[bi])}), \
                 None
     # (iv) generalized: stuck values (ok-enqueued, never ok-dequeued)
@@ -597,6 +597,8 @@ fifo_queue_spec = register_model(ModelSpec(
     hint=_fifo_hint,
     fast_check=_fifo_fast_check,
     prune=_queue_prune,
+    decode_state=lambda st: {
+        "queue": [int(v) for v in st[1:1 + int(st[0])]]},
 ))
 
 
@@ -659,4 +661,6 @@ unordered_queue_spec = register_model(ModelSpec(
     pad_state=_pad_nil,
     fast_check=_unordered_fast_check,
     prune=_queue_prune,
+    decode_state=lambda st: {
+        "items": sorted(int(v) for v in st if int(v) != NIL)},
 ))

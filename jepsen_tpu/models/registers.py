@@ -126,6 +126,10 @@ def _register_encode(spec, intern, f, value, ret_value):
     raise ValueError(f"register: unknown f {f!r}")
 
 
+def _reg_decode(st):
+    return {"value": None if int(st[0]) == NIL else int(st[0])}
+
+
 register_spec = register_model(ModelSpec(
     name="register",
     f_codes={"read": F_READ, "write": F_WRITE},
@@ -135,6 +139,7 @@ register_spec = register_model(ModelSpec(
     step=_register_step,
     make_oracle=Register,
     encode_op=_register_encode,
+    decode_state=_reg_decode,
 ))
 
 
@@ -172,6 +177,7 @@ cas_register_spec = register_model(ModelSpec(
     step=_cas_step,
     make_oracle=CASRegister,
     encode_op=_cas_encode,
+    decode_state=_reg_decode,
 ))
 
 
@@ -211,4 +217,7 @@ def multi_register_spec(keys):
         step=_multi_step,
         make_oracle=MultiRegister,
         encode_op=encode,
+        decode_state=lambda st: {
+            "values": {k: (None if int(st[i]) == NIL else int(st[i]))
+                       for k, i in k_index.items()}},
     )

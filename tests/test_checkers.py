@@ -560,13 +560,43 @@ def test_competition_all_unknown(monkeypatch):
     assert r["valid"] == "unknown"
 
 
-def test_linearizable_truncates_final_ops(monkeypatch):
+def test_linearizable_truncates_witness_fields(monkeypatch):
+    """At most 10 paths / 10 configs survive (checker.clj:213-216)."""
     from jepsen_tpu.checker import wgl
 
     def fat(spec, e, init_state, **kw):
-        return {"valid": False, "final_ops": list(range(50))}
+        return {"valid": False,
+                "final_paths": [[{"op": i}] for i in range(50)],
+                "configs": [{"model": i} for i in range(50)]}
 
     monkeypatch.setattr(wgl, "check_encoded", fat)
     c = ck.linearizable({"model": "cas-register", "algorithm": "wgl"})
     r = check(c, BAD_CAS)
-    assert len(r["final_ops"]) == 10
+    assert len(r["final_paths"]) == 10
+    assert len(r["configs"]) == 10
+
+
+def test_invalid_check_carries_knossos_witness_fields():
+    """An invalid verdict from either SEARCH engine ships the knossos
+    artifact set: op, final_paths (step-by-step (op, model) sequence),
+    previous_ok, configs with pending candidates (checker.clj:206-216;
+    VERDICT r2 missing #2). BAD_CAS is decided by the state-abstraction
+    fast path on the device engine, so use a history whose bad read
+    value IS written elsewhere (timing, not reachability, is wrong)."""
+    bad = [
+        inv(0, "write", 1), ok(0, "write", 1),
+        inv(1, "read"), ok(1, "read", 2),     # before write 2 begins
+        inv(0, "write", 2), ok(0, "write", 2),
+    ]
+    for algo in ("wgl", "jax-wgl"):
+        c = ck.linearizable({"model": "cas-register", "algorithm": algo})
+        r = check(c, bad)
+        assert r["valid"] is False
+        assert r["op"]["f"] is not None
+        assert r["final_paths"], algo
+        path = r["final_paths"][0]
+        assert all("op" in s and "model" in s for s in path)
+        # states decode into the readable model face
+        assert all(isinstance(s["model"], dict) for s in path)
+        assert r["configs"] and "pending" in r["configs"][0]
+        assert r["configs"][0]["model"] is not None

@@ -81,7 +81,11 @@ def test_basic_cas():
         client=tst.atom_client(state, meta_log),
         concurrency=10,
         generator=gen.phases(
-            {"f": "read"},
+            # MUST be wrapped in clients: a bare map op fills in "some
+            # free process" from the whole context, occasionally landing
+            # on the NEMESIS thread, which rejects client ops -- seen as
+            # a rare flake where reads[0] was a phase-2 read
+            gen.clients({"f": "read"}),
             # barrier: the phase-1 read must *complete* before phase 2's
             # writes dispatch, or the first ok read may not see 0
             gen.synchronize(gen.clients(gen.limit(n, gen.reserve(

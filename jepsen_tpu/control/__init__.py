@@ -22,7 +22,7 @@ from ..util import real_pmap
 from .core import (Lit, Remote, RemoteExecError, escape, lit,  # noqa: F401
                    throw_on_nonzero_exit)
 from .remotes import (DockerRemote, DummyRemote, K8sRemote,  # noqa: F401
-                      RetryRemote, SSHRemote)
+                      LocalRemote, RetryRemote, SSHRemote)
 
 logger = logging.getLogger(__name__)
 
@@ -122,10 +122,13 @@ def upload_string(content, remote_path):
 
 def base_remote(test):
     """Pick the remote transport for a test map (control.clj:35-40 +
-    {:dummy? true})."""
+    {:dummy? true}; {"local?": True} runs commands on the control host
+    itself -- the integration rig's control==node topology)."""
     ssh = test.get("ssh", {})
     if ssh.get("dummy?"):
         return DummyRemote(log=test.setdefault("dummy-log", []))
+    if ssh.get("local?"):
+        return LocalRemote()
     remote = test.get("remote")
     if remote is not None:
         return remote

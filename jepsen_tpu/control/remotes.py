@@ -172,6 +172,46 @@ class K8sRemote(Remote):
         return res
 
 
+class LocalRemote(Remote):
+    """Runs commands on the control host itself via ``bash -c`` -- the
+    control==node single-machine topology (the reference supports the
+    same shape by pointing SSH at localhost; this transport skips the
+    wire). Node isolation is by convention: suites derive per-node
+    ports/directories from the node name, so N "nodes" are N live
+    daemon processes on one machine. This is the default rig for the
+    integration tests: everything above the transport (daemon helpers,
+    process nemeses, log snarfing, gcc shim compiles) runs for real."""
+
+    def __init__(self, host=None):
+        self.host = host
+
+    def connect(self, conn_spec):
+        return LocalRemote(conn_spec.get("host"))
+
+    def execute(self, ctx, action):
+        import os
+        sudo = ctx.get("sudo")
+        if sudo and os.geteuid() == 0 and sudo == "root":
+            # already root on the control host: the sudo wrapper is a
+            # no-op, and minimal images often lack the binary entirely
+            ctx = {k: v for k, v in ctx.items() if k != "sudo"}
+        full = _full_cmd(ctx, action)
+        return _run(["bash", "-c", full["cmd"]], full,
+                    timeout=ctx.get("timeout"))
+
+    def upload(self, ctx, local_paths, remote_path):
+        if isinstance(local_paths, str):
+            local_paths = [local_paths]
+        return _run(["cp", "-rp", *local_paths, remote_path],
+                    {"cmd": "local cp upload"})
+
+    def download(self, ctx, remote_paths, local_path):
+        if isinstance(remote_paths, str):
+            remote_paths = [remote_paths]
+        return _run(["cp", "-rp", *remote_paths, local_path],
+                    {"cmd": "local cp download"})
+
+
 class DummyRemote(Remote):
     """No-op remote for logical-only tests ({:ssh {:dummy? true}},
     control.clj:40): every command succeeds with empty output. Records

@@ -1,0 +1,370 @@
+"""Toystore: a real, live distributed register the integration rig can
+kill.
+
+The reference proves its control plane against a 5-node docker cluster
+(reference docker/README.md:1-27, core_test.clj:122-177 ssh-test). This
+environment has no containers and no SSH stack, so the rig runs the
+control==node topology instead ({"ssh": {"local?": True}} -> commands
+execute on the control host): N "nodes" are N live server processes with
+per-node ports/data dirs, deployed, daemonized, killed, paused, and
+log-snarfed through the REAL control path (upload, start-stop-daemon,
+grepkill, SIGSTOP/SIGCONT) -- the same code an SSH cluster would use,
+minus only the wire.
+
+The server (written to ``SERVER_SRC`` and deployed by the DB) is a
+primary/follower replicated key-value register over TCP:
+
+* all writes/cas forward to the primary (lowest node id), which
+  serializes them under a lock and appends to a recovery log;
+* reads forward to the primary too -- linearizable by construction --
+  UNLESS the server runs with ``--stale``, where reads return the local
+  asynchronously-replicated copy: a REAL consistency bug the checker
+  must catch end to end.
+
+Run it yourself::
+
+    python -m jepsen_tpu.suites.toystore test --node n1 --node n2 \\
+        --node n3 --time-limit 8
+    python -m jepsen_tpu.suites.toystore test --stale ... # must FAIL
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+
+from .. import checker as cc
+from .. import cli
+from .. import client as jclient
+from .. import control as c
+from .. import db as jdb
+from .. import generator as gen
+from .. import nemesis as jnemesis
+from .. import os as jos
+from .. import tests as tst
+from ..checker import checkers as cks
+from ..checker import timeline
+
+BASE_PORT = 36950
+
+#: stdlib-only server source, deployed to each node by the DB
+SERVER_SRC = r'''
+import argparse, os, socket, socketserver, threading, time
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--port", type=int, required=True)
+ap.add_argument("--node-id", type=int, required=True)
+ap.add_argument("--peers", default="")   # host:port,... (all nodes, id order)
+ap.add_argument("--data-dir", required=True)
+ap.add_argument("--stale", action="store_true")
+ap.add_argument("--repl-delay", type=float, default=0.0)
+args = ap.parse_args()
+
+peers = [p for p in args.peers.split(",") if p]
+store, lock = {}, threading.Lock()
+log_path = os.path.join(args.data_dir, "toystore.log")
+wal_path = os.path.join(args.data_dir, "wal.txt")
+is_primary = args.node_id == 0
+primary = peers[0] if peers else None
+
+def log(msg):
+    with open(log_path, "a") as f:
+        f.write(msg + "\n")
+
+# recover from the write-ahead log
+if os.path.exists(wal_path):
+    with open(wal_path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 2:
+                store[parts[0]] = parts[1]
+log("boot node=%d primary=%s stale=%s recovered=%d"
+    % (args.node_id, is_primary, args.stale, len(store)))
+
+def wal(k, v):
+    with open(wal_path, "a") as f:
+        f.write("%s %s\n" % (k, v))
+        f.flush()
+        os.fsync(f.fileno())
+
+def replicate(k, v):
+    for i, hp in enumerate(peers):
+        if i == args.node_id:
+            continue
+        def push(hp=hp):
+            try:
+                if args.repl_delay:
+                    time.sleep(args.repl_delay)
+                h, p = hp.rsplit(":", 1)
+                with socket.create_connection((h, int(p)), 1) as s:
+                    s.sendall(("REPL %s %s\n" % (k, v)).encode())
+                    s.recv(16)
+            except OSError:
+                pass
+        threading.Thread(target=push, daemon=True).start()
+
+def forward(line):
+    h, p = primary.rsplit(":", 1)
+    with socket.create_connection((h, int(p)), 2) as s:
+        s.sendall((line + "\n").encode())
+        return s.makefile().readline().strip()
+
+def apply_op(parts):
+    op = parts[0]
+    with lock:
+        if op == "W":
+            store[parts[1]] = parts[2]
+            wal(parts[1], parts[2])
+            replicate(parts[1], parts[2])
+            return "OK"
+        if op == "R":
+            return "VAL %s" % store.get(parts[1], "nil")
+        if op == "CAS":
+            cur = store.get(parts[1], "nil")
+            if cur != parts[2]:
+                return "FAIL %s" % cur
+            store[parts[1]] = parts[3]
+            wal(parts[1], parts[3])
+            replicate(parts[1], parts[3])
+            return "OK"
+    return "ERR bad-op"
+
+class H(socketserver.StreamRequestHandler):
+    def handle(self):
+        line = self.rfile.readline().decode().strip()
+        if not line:
+            return
+        parts = line.split()
+        try:
+            if parts[0] == "REPL":
+                with lock:
+                    store[parts[1]] = parts[2]
+                out = "OK"
+            elif parts[0] == "R" and args.stale and not is_primary:
+                # the consistency bug: serve the async local copy
+                with lock:
+                    out = "VAL %s" % store.get(parts[1], "nil")
+            elif is_primary:
+                out = apply_op(parts)
+            else:
+                out = forward(line)
+        except OSError as e:
+            out = "ERR %s" % e
+        self.wfile.write((out + "\n").encode())
+
+class Srv(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+
+Srv(("127.0.0.1", args.port), H).serve_forever()
+'''
+
+
+def node_id(test, node):
+    return test["nodes"].index(node)
+
+
+def node_port(test, node):
+    return test.get("base-port", BASE_PORT) + node_id(test, node)
+
+
+def node_dir(test, node):
+    return f"{test.get('scratch-dir', '/tmp/jepsen-toystore')}/{node}"
+
+
+def peers(test):
+    return ",".join(f"127.0.0.1:{node_port(test, n)}"
+                    for n in test["nodes"])
+
+
+class ToystoreDB(jdb.DB, jdb.Process, jdb.Pause, jdb.Primary,
+                 jdb.LogFiles):
+    """Deploys the server source and manages it with the real daemon
+    helpers (start-stop-daemon, grepkill, SIGSTOP/SIGCONT) -- every
+    protocol the combined nemesis packages drive (db.clj:11-41)."""
+
+    def _marker(self, test, node):
+        # unique SPACE-FREE argv marker (grepkill interpolates the
+        # pattern into a bash pipeline unquoted): the deployed script's
+        # full path appears in this node's argv and nobody else's
+        return f"{node_dir(test, node)}/toystore.py"
+
+    def setup(self, test, node):
+        from ..control import util as cu
+        d = node_dir(test, node)
+        c.exec_("mkdir", "-p", d)
+        c.upload_string(SERVER_SRC, f"{d}/toystore.py")
+        self.start(test, node)
+        cu.await_tcp_port(node_port(test, node), timeout_s=10,
+                          host="127.0.0.1")
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        c.exec_star("rm", "-rf", node_dir(test, node))
+
+    def start(self, test, node):
+        from ..control import util as cu
+        d = node_dir(test, node)
+        argv = ["--port", str(node_port(test, node)),
+                "--node-id", str(node_id(test, node)),
+                "--peers", peers(test), "--data-dir", d]
+        if test.get("stale"):
+            # lag replication so follower reads observably trail the
+            # primary (localhost replication is otherwise sub-ms and
+            # the staleness rarely lands inside an op window)
+            argv += ["--stale", "--repl-delay",
+                     str(test.get("repl-delay", 0.3))]
+        cu.start_daemon("/usr/bin/env", "python3", f"{d}/toystore.py",
+                        *argv, logfile=f"{d}/daemon.out",
+                        pidfile=f"{d}/toystore.pid")
+
+    def kill(self, test, node):
+        from ..control import util as cu
+        cu.stop_daemon(pidfile=f"{node_dir(test, node)}/toystore.pid")
+        cu.grepkill(self._marker(test, node))
+
+    def pause(self, test, node):
+        from ..control import util as cu
+        cu.grepkill(self._marker(test, node), signal="STOP")
+
+    def resume(self, test, node):
+        from ..control import util as cu
+        cu.grepkill(self._marker(test, node), signal="CONT")
+
+    def primaries(self, test):
+        return [test["nodes"][0]]
+
+    def setup_primary(self, test, node):
+        pass
+
+    def log_files(self, test, node):
+        d = node_dir(test, node)
+        return [f"{d}/toystore.log", f"{d}/daemon.out"]
+
+
+class ToystoreClient(jclient.Client):
+    """Line-protocol TCP client against this process's node."""
+
+    def __init__(self, node=None):
+        self.node = node
+
+    def open(self, test, node):
+        return ToystoreClient(node)
+
+    def _call(self, test, line, timeout=2.0):
+        with socket.create_connection(
+                ("127.0.0.1", node_port(test, self.node)),
+                timeout) as s:
+            s.sendall((line + "\n").encode())
+            s.settimeout(timeout)
+            return s.makefile().readline().strip()
+
+    def invoke(self, test, op):
+        out = dict(op)
+        f = op["f"]
+        try:
+            if f == "read":
+                resp = self._call(test, "R x")
+                if resp.startswith("VAL"):
+                    v = resp.split()[1]
+                    out.update(type="ok",
+                               value=None if v == "nil" else int(v))
+                else:
+                    out.update(type="fail", error=resp)
+            elif f == "write":
+                resp = self._call(test, f"W x {op['value']}")
+                out["type"] = "ok" if resp == "OK" else "info"
+                if resp != "OK":
+                    out["error"] = resp
+            else:
+                old, new = op["value"]
+                resp = self._call(
+                    test, f"CAS x {'nil' if old is None else old} {new}")
+                if resp == "OK":
+                    out["type"] = "ok"
+                elif resp.startswith("FAIL"):
+                    out["type"] = "fail"
+                else:
+                    out.update(type="info", error=resp)
+        except OSError as e:
+            # connection refused/timeout: reads definitely didn't
+            # happen; writes are indeterminate
+            out.update(type="fail" if f == "read" else "info",
+                       error=repr(e))
+        return out
+
+
+def r(test, ctx):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def w(test, ctx):
+    return {"type": "invoke", "f": "write", "value": random.randint(0, 4)}
+
+
+def cas(test, ctx):
+    return {"type": "invoke", "f": "cas",
+            "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+
+def toystore_test(opts):
+    test = dict(tst.noop_test())
+    test.update(opts)
+    nemesis_mode = opts.get("nemesis-mode", "kill")
+    if nemesis_mode == "kill":
+        nem = jnemesis.node_start_stopper(
+            lambda test_, nodes: [random.choice(nodes)],
+            lambda test_, node: ToystoreDB().kill(test_, node),
+            lambda test_, node: ToystoreDB().start(test_, node))
+    elif nemesis_mode == "pause":
+        nem = jnemesis.node_start_stopper(
+            lambda test_, nodes: [random.choice(nodes)],
+            lambda test_, node: ToystoreDB().pause(test_, node),
+            lambda test_, node: ToystoreDB().resume(test_, node))
+    else:
+        nem = jnemesis.noop
+    test.update({
+        "name": "toystore",
+        "ssh": {"local?": True},
+        "os": jos.noop,
+        "db": ToystoreDB(),
+        "client": ToystoreClient(),
+        "nemesis": nem,
+        "generator": gen.time_limit(
+            opts.get("time-limit", 8),
+            gen.nemesis(
+                None if nemesis_mode == "none" else
+                gen.cycle(gen.sleep(2),
+                          {"type": "info", "f": "start"},
+                          gen.sleep(2),
+                          {"type": "info", "f": "stop"}),
+                gen.stagger(0.05, gen.mix([r, w, cas])))),
+        "checker": cc.compose({
+            "linear": cks.linearizable(
+                {"model": "cas-register",
+                 "algorithm": opts.get("algorithm", "competition")}),
+            "timeline": timeline.html(),
+        }),
+    })
+    return test
+
+
+def _opt_spec(parser):
+    parser.add_argument("--algorithm", default="competition")
+    parser.add_argument("--stale", action="store_true",
+                        help="serve follower reads from the async local "
+                             "copy (a real linearizability bug)")
+    parser.add_argument("--nemesis-mode", default="kill",
+                        choices=["kill", "pause", "none"])
+    parser.add_argument("--base-port", type=int, default=BASE_PORT)
+
+
+def main(argv=None):
+    cmds = {}
+    cmds.update(cli.single_test_cmd({"test-fn": toystore_test,
+                                     "opt-spec": _opt_spec}))
+    cmds.update(cli.serve_cmd())
+    cli.run(cmds, argv)
+
+
+if __name__ == "__main__":
+    cli.hard_main(main)

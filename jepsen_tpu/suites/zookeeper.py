@@ -263,4 +263,4 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main()
+    cli.hard_main(main)

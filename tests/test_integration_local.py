@@ -1,0 +1,133 @@
+"""Live-process integration tests: the control==node rig.
+
+The reference proves its control plane against a real 5-node cluster
+(docker/README.md:1-27, core_test.clj:122-177). This image has no SSH
+stack and no container runtime, so these tests run the LocalRemote
+topology instead: commands execute on the control host for real --
+start-stop-daemon, grepkill, SIGSTOP/SIGCONT, file upload, gcc compiles
+-- against N live toystore server processes (jepsen_tpu/suites/
+toystore.py). Everything above the transport is the same code an SSH
+cluster would run; tests/test_integration_ssh.py exercises the wire
+itself where an sshd exists.
+"""
+
+import os
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu import core
+from jepsen_tpu.suites import toystore
+
+
+@pytest.fixture(autouse=True)
+def _isolated_store(tmp_path, monkeypatch):
+    from jepsen_tpu import store
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+
+
+def _opts(tmp_path, base_port, **kw):
+    opts = {
+        "nodes": ["n1", "n2", "n3"],
+        "time-limit": 5,
+        "base-port": base_port,
+        "scratch-dir": str(tmp_path / "nodes"),
+        "algorithm": "competition",
+    }
+    opts.update(kw)
+    return opts
+
+
+def _store_dir(test):
+    import pathlib
+
+    from jepsen_tpu import store
+    return pathlib.Path(store.path(test))
+
+
+def test_toystore_end_to_end_with_kill_nemesis(tmp_path):
+    """Full lifecycle against 3 live daemons with a kill/restart
+    nemesis: deploy, daemonize, kill -9, restart with WAL recovery,
+    check linearizability, snarf real log files."""
+    test = toystore.toystore_test(_opts(tmp_path, 37110,
+                                        **{"nemesis-mode": "kill"}))
+    test = core.run(test)
+    res = test["results"]
+    assert res["valid"] is True, res
+    hist = test["history"]
+    oks = [o for o in hist if o.get("type") == "ok"
+           and o.get("process") != "nemesis"]
+    assert len(oks) >= 20, "live ops actually ran"
+    # the nemesis really killed nodes: its ops carry per-node results
+    nem = [o for o in hist if o.get("process") == "nemesis"
+           and o.get("type") == "info" and o.get("f") == "start"]
+    assert nem, "nemesis ran"
+    # real log files snarfed off the nodes into the store dir
+    d = _store_dir(test)
+    logs = [p for p in (d / "n1").glob("*") if p.name == "toystore.log"] \
+        if (d / "n1").exists() else []
+    assert logs and "boot node=0" in logs[0].read_text()
+    # no server processes left behind
+    left = os.popen("ps aux | grep toystore.py | grep -v grep").read()
+    assert str(tmp_path) not in left
+
+
+def test_toystore_stale_reads_detected(tmp_path):
+    """The --stale server serves follower reads from an async local copy
+    lagging 300 ms behind the primary: a REAL consistency bug the
+    checker must catch, with the knossos-style witness attached."""
+    test = toystore.toystore_test(_opts(
+        tmp_path, 37130, concurrency=6, stale=True,
+        **{"nemesis-mode": "none", "time-limit": 8}))
+    test = core.run(test)
+    res = test["results"]
+    assert res["valid"] is False, res
+    lin = res["linear"]
+    assert lin["op"]["f"] in ("read", "cas")
+    assert lin["final_paths"], "witness path attached"
+
+
+def test_clock_shims_compile_and_run_on_node(tmp_path, monkeypatch):
+    """The clock nemesis's compile-on-node recipe (upload C source, gcc
+    -O2) against the real filesystem + compiler; the binaries execute
+    (usage errors only -- nobody actually skews this machine's clock)."""
+    from jepsen_tpu.nemesis import time as ntime
+    monkeypatch.setattr(ntime, "DIR", str(tmp_path / "jepsen-bin"))
+    test = {"nodes": ["n1"], "ssh": {"local?": True}}
+    with core.with_sessions(test):
+        with c.on("n1"):
+            ntime.compile_tools()
+            for tool in ("bump-time", "strobe-time"):
+                assert os.path.exists(f"{ntime.DIR}/{tool}")
+                # running without args must fail with usage, not crash
+                res = c.exec_star(f"{ntime.DIR}/{tool}")
+                assert res["exit"] != 0
+                assert "usage" in (res["out"] + res["err"]).lower()
+
+
+def test_daemon_helpers_against_live_process(tmp_path):
+    """start_daemon / daemon_running / stop_daemon / grepkill drive a
+    real background process through its lifecycle."""
+    from jepsen_tpu.control import util as cu
+    test = {"nodes": ["n1"], "ssh": {"local?": True}}
+    script = tmp_path / "spin.sh"
+    script.write_text("#!/bin/bash\nwhile true; do sleep 0.2; done\n")
+    script.chmod(0o755)
+    pidfile = str(tmp_path / "spin.pid")
+    with core.with_sessions(test):
+        with c.on("n1"):
+            assert cu.start_daemon(str(script), pidfile=pidfile,
+                                   logfile=str(tmp_path / "spin.log"))
+            assert cu.daemon_running(pidfile)
+            cu.stop_daemon(pidfile=pidfile)
+            assert not cu.daemon_running(pidfile)
+
+
+@pytest.mark.parametrize("mode", ["pause"])
+def test_toystore_pause_nemesis(tmp_path, mode):
+    """SIGSTOP/SIGCONT nemesis against live daemons: paused nodes stall
+    or fail ops; the system stays linearizable throughout."""
+    test = toystore.toystore_test(_opts(
+        tmp_path, 37150, **{"nemesis-mode": mode, "time-limit": 5}))
+    test = core.run(test)
+    assert test["results"]["valid"] is True, test["results"]

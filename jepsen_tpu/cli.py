@@ -315,7 +315,13 @@ def hard_main(main_fn):
         main_fn()
         code = 0
     except SystemExit as e:
-        code = e.code if isinstance(e.code, int) else 254
+        if e.code is None:           # bare sys.exit() = success
+            code = 0
+        elif isinstance(e.code, int):
+            code = e.code
+        else:                        # sys.exit("message")
+            print(e.code, file=sys.stderr)
+            code = 254
     except KeyboardInterrupt:
         code = 130
     except BaseException:  # noqa: BLE001 - teardown must not run
@@ -324,4 +330,4 @@ def hard_main(main_fn):
     logging.shutdown()
     sys.stdout.flush()
     sys.stderr.flush()
-    os._exit(code if code is not None else 0)
+    os._exit(code)

@@ -1,0 +1,320 @@
+"""Minimal ZooKeeper wire protocol (jute) client for the data path.
+
+The round-2 zookeeper suite screen-scraped zkCli.sh output, with a
+load-bearing comment about which zkCli version's grammar it assumed
+(ADVICE/VERDICT r2). This module replaces the data path with the actual
+client protocol: length-prefixed jute frames over TCP -- connect
+handshake, then getData/setData/create with real error codes, so CAS
+maps to SetData-with-expected-version and a BadVersion (-103) reply
+instead of parsing shell output.
+
+Format (big-endian), reconstructed from the public jute definitions
+(zookeeper.jute) and protocol documentation:
+
+* frame: 4-byte length prefix (excluding itself)
+* primitives: int (4), long (8), bool (1), buffer (len + bytes, -1 =
+  null), string (utf-8 buffer), vector (count + items)
+* session: ConnectRequest{proto=0, lastZxid=0, timeout, session=0,
+  passwd[16], readOnly} -> ConnectResponse
+* requests: RequestHeader{xid, type} + record; replies:
+  ReplyHeader{xid, zxid, err} + record. Watch events arrive with
+  xid == -1 and are skipped; pings are xid == -2.
+
+``FakeZkServer`` implements the same protocol server-side over a plain
+dict -- enough for the integration rig to drive the client through real
+sockets (tests/test_suite_zookeeper.py). The encoder/decoder pair being
+exercised against itself means the BYTE layout is only as good as this
+reconstruction; against a real ensemble any mismatch fails loudly at
+the connect handshake rather than silently corrupting values.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+# request types (zookeeper protocol)
+OP_CREATE, OP_DELETE, OP_EXISTS, OP_GETDATA, OP_SETDATA = 1, 2, 3, 4, 5
+OP_PING, OP_CLOSE = 11, -11
+
+# error codes
+OK = 0
+NO_NODE = -101
+BAD_VERSION = -103
+NODE_EXISTS = -110
+
+#: world:anyone ACL with all permissions
+OPEN_ACL = [(31, "world", "anyone")]
+
+
+class ZkError(Exception):
+    def __init__(self, code):
+        self.code = code
+        super().__init__(f"zookeeper error {code}")
+
+
+class _Enc:
+    def __init__(self):
+        self.b = bytearray()
+
+    def int(self, v):
+        self.b += struct.pack(">i", v)
+        return self
+
+    def long(self, v):
+        self.b += struct.pack(">q", v)
+        return self
+
+    def bool(self, v):
+        self.b += b"\x01" if v else b"\x00"
+        return self
+
+    def buffer(self, v):
+        if v is None:
+            return self.int(-1)
+        self.int(len(v))
+        self.b += v
+        return self
+
+    def string(self, v):
+        return self.buffer(v.encode())
+
+
+class _Dec:
+    def __init__(self, b):
+        self.b = b
+        self.i = 0
+
+    def int(self):
+        v = struct.unpack_from(">i", self.b, self.i)[0]
+        self.i += 4
+        return v
+
+    def long(self):
+        v = struct.unpack_from(">q", self.b, self.i)[0]
+        self.i += 8
+        return v
+
+    def bool(self):
+        v = self.b[self.i] != 0
+        self.i += 1
+        return v
+
+    def buffer(self):
+        n = self.int()
+        if n < 0:
+            return None
+        v = bytes(self.b[self.i:self.i + n])
+        self.i += n
+        return v
+
+    def string(self):
+        v = self.buffer()
+        return None if v is None else v.decode()
+
+    def stat(self):
+        names = ("czxid", "mzxid", "ctime", "mtime")
+        out = {k: self.long() for k in names}
+        out["version"] = self.int()
+        out["cversion"] = self.int()
+        out["aversion"] = self.int()
+        out["ephemeralOwner"] = self.long()
+        out["dataLength"] = self.int()
+        out["numChildren"] = self.int()
+        out["pzxid"] = self.long()
+        return out
+
+
+def _stat_bytes(version=0, data_len=0, zxid=0):
+    e = _Enc()
+    for _ in range(4):
+        e.long(zxid)
+    e.int(version).int(0).int(0).long(0).int(data_len).int(0).long(zxid)
+    return bytes(e.b)
+
+
+def _send_frame(sock, payload):
+    sock.sendall(struct.pack(">i", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("zookeeper connection closed")
+        out += chunk
+    return out
+
+
+def _recv_frame(sock):
+    (n,) = struct.unpack(">i", _recv_exact(sock, 4))
+    return _recv_exact(sock, n)
+
+
+class ZkWireClient:
+    """One session: connect handshake then sequential request/reply."""
+
+    def __init__(self, host, port, timeout_s=5.0,
+                 session_timeout_ms=10_000):
+        self.sock = socket.create_connection((host, port), timeout_s)
+        self.sock.settimeout(timeout_s)
+        self.xid = 0
+        e = _Enc()
+        e.int(0).long(0).int(session_timeout_ms).long(0)
+        e.buffer(b"\x00" * 16)
+        e.bool(False)                       # readOnly (3.4+)
+        _send_frame(self.sock, bytes(e.b))
+        d = _Dec(_recv_frame(self.sock))
+        d.int()                             # protocol version
+        self.negotiated_timeout = d.int()
+        self.session_id = d.long()
+
+    def close(self):
+        try:
+            e = _Enc()
+            e.int(1).int(OP_CLOSE)
+            _send_frame(self.sock, bytes(e.b))
+        except OSError:
+            pass
+        finally:
+            self.sock.close()
+
+    def _call(self, op, body):
+        self.xid += 1
+        xid = self.xid
+        e = _Enc()
+        e.int(xid).int(op)
+        e.b += body
+        _send_frame(self.sock, bytes(e.b))
+        while True:
+            d = _Dec(_recv_frame(self.sock))
+            rxid = d.int()
+            d.long()                        # zxid
+            err = d.int()
+            if rxid in (-1, -2):            # watch event / ping: skip
+                continue
+            if rxid != xid:
+                raise ConnectionError(
+                    f"xid mismatch: sent {xid}, got {rxid}")
+            if err != OK:
+                raise ZkError(err)
+            return d
+
+    def create(self, path, data, flags=0):
+        e = _Enc()
+        e.string(path).buffer(data)
+        e.int(len(OPEN_ACL))
+        for perms, scheme, ident in OPEN_ACL:
+            e.int(perms).string(scheme).string(ident)
+        e.int(flags)
+        return self._call(OP_CREATE, bytes(e.b)).string()
+
+    def get_data(self, path):
+        """-> (data bytes, stat dict)."""
+        e = _Enc()
+        e.string(path).bool(False)
+        d = self._call(OP_GETDATA, bytes(e.b))
+        data = d.buffer()
+        return data, d.stat()
+
+    def set_data(self, path, data, version=-1):
+        """version >= 0 = compare-and-set; -1 = unconditional."""
+        e = _Enc()
+        e.string(path).buffer(data).int(version)
+        return self._call(OP_SETDATA, bytes(e.b)).stat()
+
+
+class FakeZkServer:
+    """Protocol-emulating single-node server over a dict, for the rig:
+    znodes with versioned CAS semantics, served on real sockets."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self.store = {}                 # path -> [data bytes, version]
+        self.lock = threading.Lock()
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.sock.listen(16)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self._stop.set()
+        self.sock.close()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._session, args=(conn,),
+                             daemon=True).start()
+
+    def _session(self, conn):
+        try:
+            d = _Dec(_recv_frame(conn))     # ConnectRequest
+            d.int(), d.long()
+            timeout = d.int()
+            e = _Enc()
+            e.int(0).int(timeout).long(0x1234).buffer(b"\x00" * 16)
+            e.bool(False)
+            _send_frame(conn, bytes(e.b))
+            while True:
+                d = _Dec(_recv_frame(conn))
+                xid, op = d.int(), d.int()
+                if op == OP_CLOSE:
+                    self._reply(conn, xid, OK, b"")
+                    return
+                try:
+                    body = self._handle(op, d)
+                    self._reply(conn, xid, OK, body)
+                except ZkError as z:
+                    self._reply(conn, xid, z.code, b"")
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            conn.close()
+
+    def _handle(self, op, d):
+        if op == OP_PING:
+            return b""
+        path = d.string()
+        with self.lock:
+            if op == OP_GETDATA:
+                d.bool()
+                if path not in self.store:
+                    raise ZkError(NO_NODE)
+                data, version = self.store[path]
+                e = _Enc()
+                e.buffer(data)
+                e.b += _stat_bytes(version, len(data or b""))
+                return bytes(e.b)
+            if op == OP_CREATE:
+                data = d.buffer()
+                if path in self.store:
+                    raise ZkError(NODE_EXISTS)
+                self.store[path] = [data, 0]
+                return bytes(_Enc().string(path).b)
+            if op == OP_SETDATA:
+                data = d.buffer()
+                version = d.int()
+                if path not in self.store:
+                    raise ZkError(NO_NODE)
+                cur = self.store[path]
+                if version >= 0 and cur[1] != version:
+                    raise ZkError(BAD_VERSION)
+                cur[0], cur[1] = data, cur[1] + 1
+                return _stat_bytes(cur[1], len(data or b""))
+        raise ZkError(-2)                   # unimplemented
+
+    @staticmethod
+    def _reply(conn, xid, err, body):
+        e = _Enc()
+        e.int(xid).long(1).int(err)
+        e.b += body
+        _send_frame(conn, bytes(e.b))

@@ -1,9 +1,10 @@
 """ZooKeeper test suite: the minimal single-file consumer (reference
 zookeeper/src/jepsen/zookeeper.clj, 137 LoC — the tutorial's target).
 
-A single compare-and-set register held in a znode, driven through the
-zkCli shell (no Python client dependency), a random-halves partitioner,
-and the device linearizability checker::
+A single compare-and-set register held in a znode, driven over the
+actual client wire protocol (suites/zk_proto.py -- no Python client
+dependency and no shell scraping), a random-halves partitioner, and the
+device linearizability checker::
 
     python -m jepsen_tpu.suites.zookeeper test \\
         --node n1 --node n2 --node n3 --time-limit 15
@@ -28,9 +29,8 @@ from ..checker import checkers as cks
 from ..checker import perf as cperf
 from ..checker import timeline
 from ..os import debian
+from . import zk_proto
 
-#: needs >= 3.6: `get -s` / `set -v` grammar, and zkCli exiting nonzero
-#: on command errors (ZOOKEEPER-3482) -- both load-bearing for the client
 VERSION = "3.6.3"
 
 
@@ -57,9 +57,8 @@ DIR = "/opt/zookeeper"
 
 
 class ZkDB(jdb.DB, jdb.LogFiles):
-    """Installs ZooKeeper from the release tarball and (re)configures the
-    ensemble (zookeeper.clj:40-72 uses the 3.4 distro package; the zkCli
-    grammar this suite's client needs ships with >= 3.6)."""
+    """Installs ZooKeeper from the release tarball and (re)configures
+    the ensemble (zookeeper.clj:40-72 uses the 3.4 distro package)."""
 
     def __init__(self, version=VERSION):
         self.version = version
@@ -105,77 +104,75 @@ def cas(test, ctx):
 
 
 class ZkClient(jclient.Client):
-    """CAS register in the /jepsen znode via zkCli.sh on the node
-    (zookeeper.clj:78-104 uses avout; the shell round-trip keeps this
-    suite dependency-free). CAS uses the znode version for atomicity."""
+    """CAS register in the /jepsen znode over the actual client wire
+    protocol (suites/zk_proto.py): getData/setData with real version
+    numbers, CAS = SetData-with-expected-version answered by BadVersion
+    (-103). Replaces round 2's zkCli.sh screen-scraping, which depended
+    on one zkCli version's output grammar (zookeeper.clj:78-104 uses
+    avout; the wire client keeps this suite dependency-free without
+    parsing shell output)."""
 
-    ZKCLI = "/opt/zookeeper/bin/zkCli.sh"
+    PATH = "/jepsen"
 
-    def __init__(self, node=None):
+    def __init__(self, node=None, port=2181):
         self.node = node
+        self.port = port
+        self.conn = None
 
     def open(self, test, node):
-        cl = ZkClient(node)
-        return cl
+        return ZkClient(node, test.get("zk-port", 2181))
+
+    def _session(self):
+        if self.conn is None:
+            self.conn = zk_proto.ZkWireClient(self.node, self.port,
+                                              timeout_s=5.0)
+        return self.conn
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+            self.conn = None
 
     def setup(self, test):
-        with c.on(self.node):
-            c.exec_star(self.ZKCLI, "create", "/jepsen", "0")
+        try:
+            self._session().create(self.PATH, b"0")
+        except zk_proto.ZkError as e:
+            if e.code != zk_proto.NODE_EXISTS:
+                raise
 
     def _get(self):
-        out = c.exec_(self.ZKCLI, "get", "-s", "/jepsen")
-        lines = [ln.strip() for ln in str(out).splitlines()
-                 if ln.strip()]
-        # zkCli intersperses WATCHER::/WatchedEvent/log noise; with
-        # `get -s` the value is everything before the first stat field
-        # (cZxid = ...). This suite only ever writes small integers, so
-        # the last pre-stat line must parse as one -- anything else is a
-        # parse failure we surface explicitly rather than mis-read.
-        stat_at = next(i for i, ln in enumerate(lines)
-                       if ln.startswith("cZxid"))
-        raw = lines[stat_at - 1] if stat_at > 0 else ""
-        try:
-            value = int(raw)
-        except ValueError:
-            raise ValueError(
-                f"unparseable znode value {raw!r} before stat block "
-                f"(suite writes only integers; zkCli noise?)") from None
-        version = next(int(ln.split("=")[-1].strip())
-                       for ln in lines if ln.startswith("dataVersion"))
-        return value, version
+        data, stat = self._session().get_data(self.PATH)
+        return int(data.decode()), stat["version"]
 
     def invoke(self, test, op):
         out_op = dict(op)
         try:
-            with c.on(self.node):
-                if op["f"] == "read":
-                    value, _ = self._get()
-                    out_op.update(type="ok", value=value)
-                elif op["f"] == "write":
-                    c.exec_(self.ZKCLI, "set", "/jepsen",
-                            str(op["value"]))
-                    out_op["type"] = "ok"
+            if op["f"] == "read":
+                value, _ = self._get()
+                out_op.update(type="ok", value=value)
+            elif op["f"] == "write":
+                self._session().set_data(self.PATH,
+                                         str(op["value"]).encode())
+                out_op["type"] = "ok"
+            else:
+                old, new = op["value"]
+                value, version = self._get()
+                if value != old:
+                    out_op["type"] = "fail"
                 else:
-                    old, new = op["value"]
-                    value, version = self._get()
-                    if value != old:
+                    try:
+                        self._session().set_data(
+                            self.PATH, str(new).encode(),
+                            version=version)
+                        out_op["type"] = "ok"
+                    except zk_proto.ZkError as e:
+                        if e.code != zk_proto.BAD_VERSION:
+                            raise
+                        # another writer interleaved: a clean loss
                         out_op["type"] = "fail"
-                    else:
-                        # version-guarded set: loses cleanly when another
-                        # writer interleaved. zkCli >= 3.6 exits nonzero
-                        # on BadVersion (ZOOKEEPER-3482); the output
-                        # check is belt and braces.
-                        res = c.exec_star(self.ZKCLI, "set", "-v",
-                                          str(version), "/jepsen",
-                                          str(new))
-                        txt = str(res.get("out", "")) + \
-                            str(res.get("err", ""))
-                        if res.get("exit") != 0 or "BadVersion" in txt \
-                                or "version No is not valid" in txt:
-                            out_op["type"] = "fail"
-                        else:
-                            out_op["type"] = "ok"
-        except Exception as e:  # noqa: BLE001 - indeterminate
+        except (zk_proto.ZkError, OSError) as e:
+            # drop the session: reconnect on the next op
+            self.close(test)
             out_op.update(
                 type=("fail" if op["f"] == "read" else "info"),
                 error=repr(e))

@@ -26,7 +26,8 @@ Non-cycle anomalies caught during inference (elle's names):
 
 from __future__ import annotations
 
-from . import RW, WR, WW, Graph, check_graph
+from . import (DEFAULT_ANOMALIES, RW, WR, WW, Graph, add_realtime_edges,
+               check_graph, invocation_times)
 from .. import history as h
 
 
@@ -55,11 +56,14 @@ def _appends(txn):
     return [(mop[1], mop[2]) for mop in txn if mop[0] == "append"]
 
 
-def analyze(history, anomalies=("G0", "G1c", "G-single", "G2")) -> dict:
+def analyze(history, anomalies=DEFAULT_ANOMALIES,
+            realtime=True) -> dict:
     """Infer the dependency graph from an append history and classify its
     anomalies. Returns the check_graph result plus inference-level
-    anomalies."""
+    anomalies. ``realtime`` adds RT (completed-before-invoked) edges,
+    enabling the strict-serializability *-realtime classes."""
     history = [op for op in history if op.get("f") in ("txn", None)]
+    inv_time = invocation_times(history)
     oks = [op for op in history if op.get("type") == "ok"]
     fails = [op for op in history if op.get("type") == "fail"]
     infos = [op for op in history if op.get("type") == "info"]
@@ -187,6 +191,11 @@ def analyze(history, anomalies=("G0", "G1c", "G-single", "G2")) -> dict:
                               f"{k}: read ended at {lst[-1] if lst else '[]'}"
                               f"; {nxt} was appended next")
 
+    if realtime:
+        add_realtime_edges(
+            graph, oks, lambda op: op.get("time", 0),
+            lambda op: inv_time.get(id(op), op.get("time", 0)))
+
     res = check_graph(graph, oks, anomalies)
     res["anomalies"].update(found)
     res["anomaly_types"] = sorted(set(res["anomaly_types"]) |
@@ -205,8 +214,8 @@ def check(history, opts=None) -> dict:
     """Checker entry: complete invoke/ok pairs are analyzed; returns
     {"valid": ..., "anomaly_types": [...], "anomalies": {...}}."""
     opts = opts or {}
-    anomalies = tuple(opts.get("anomalies",
-                               ("G0", "G1c", "G-single", "G2")))
-    res = analyze(h.complete(history), anomalies)
+    anomalies = tuple(opts.get("anomalies", DEFAULT_ANOMALIES))
+    res = analyze(h.complete(history), anomalies,
+                  realtime=opts.get("realtime", True))
     res["valid?"] = res["valid"]
     return res

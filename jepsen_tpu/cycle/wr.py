@@ -15,12 +15,16 @@ documented options:
   of B). Adds WW and RW edges along that order.
 
 Non-cycle anomalies: G1a (read a failed txn's write), G1b (read a
-non-final write of some txn), dirty-update-ish lost writes are left to
-the register checkers."""
+non-final write of some txn), ``internal`` (a txn's own reads disagree
+with its preceding mops), and ``lost-update`` (two committed txns both
+read-modify-write the same version). Realtime (RT) edges are inferred
+by default, enabling the strict-serializability *-realtime cycle
+classes; pass ``{"realtime": False}`` for plain serializability."""
 
 from __future__ import annotations
 
-from . import RW, WR, WW, Graph, check_graph
+from . import (DEFAULT_ANOMALIES, RW, WR, WW, Graph, add_realtime_edges,
+               check_graph, invocation_times)
 from .. import history as h
 from ..txn import ext_reads, ext_writes, int_write_mops
 
@@ -31,16 +35,12 @@ def _txn(op):
 
 def analyze(history, opts=None) -> dict:
     opts = opts or {}
-    anomalies = tuple(opts.get("anomalies",
-                               ("G0", "G1c", "G-single", "G2")))
+    anomalies = tuple(opts.get("anomalies", DEFAULT_ANOMALIES))
     history = [op for op in history if op.get("f") in ("txn", None)]
     # realtime precedence needs invocation times; pair them up before
     # dropping invokes (completion-only test histories fall back to
     # treating ops as point events)
-    inv_time = {}
-    for inv, comp in h.pairs(history):
-        if inv is not None and comp is not None:
-            inv_time[id(comp)] = inv.get("time", comp.get("time", 0))
+    inv_time = invocation_times(history)
     oks = [op for op in history if op.get("type") == "ok"]
     fails = [op for op in history if op.get("type") == "fail"]
 
@@ -73,6 +73,36 @@ def analyze(history, opts=None) -> dict:
 
     graph = Graph(len(oks))
     garbage = []
+
+    # internal consistency: within one txn, a read of k must return the
+    # latest preceding mop's value for k (elle's `internal` anomaly)
+    for op in oks:
+        seen: dict = {}
+        for mop in _txn(op):
+            f_, k, v = mop[0], mop[1], mop[2]
+            if f_ == "r":
+                if k in seen and v != seen[k]:
+                    found.setdefault("internal", []).append(
+                        {"key": k, "expected": seen[k], "read": v,
+                         "op": dict(op)})
+                if v is not None:
+                    seen[k] = v
+            else:
+                seen[k] = v
+
+    # lost update: two committed txns both read version v of k and both
+    # write k -- each believes it replaced v (elle's `lost-update`)
+    rmw: dict = {}
+    for op in oks:
+        reads, writes = ext_reads(_txn(op)), ext_writes(_txn(op))
+        for k, v in reads.items():
+            if v is not None and k in writes:
+                rmw.setdefault((k, v), []).append(op)
+    for (k, v), group in rmw.items():
+        if len(group) >= 2:
+            found.setdefault("lost-update", []).append(
+                {"key": k, "value": v,
+                 "ops": [dict(o) for o in group]})
 
     for op in oks:
         for k, v in ext_reads(_txn(op)).items():
@@ -166,6 +196,13 @@ def analyze(history, opts=None) -> dict:
                         graph.add(idx[id(op)], idx[id(b)], RW,
                                   f"{k}: read {v}, overwritten by a "
                                   "realtime-later write")
+
+    if opts.get("realtime", True):
+        # strict-serializability: a completed-before-invoked pair is
+        # realtime-ordered; cycles needing these edges become the
+        # *-realtime anomaly classes
+        add_realtime_edges(graph, oks,
+                           lambda op: op.get("time", 0), invoked_at)
 
     res = check_graph(graph, oks, anomalies)
     res["anomalies"].update(found)

@@ -381,3 +381,177 @@ def test_wr_sequential_keys_intra_txn_witness():
     hist[2]["process"] = hist[3]["process"] = 9
     res = wrx.analyze(hist, {"sequential_keys": True})
     assert res["valid"] is False
+
+
+# -- strict-serializability (realtime) classes --------------------------------
+
+def P(*txns):
+    """Paired invoke/ok history from (inv_time, ok_time, mops) tuples."""
+    from jepsen_tpu import history as hh
+    out = []
+    for i, (t0, t1, mops) in enumerate(txns):
+        out.append({"type": "invoke", "f": "txn", "process": i,
+                    "time": t0, "value": mops})
+        out.append({"type": "ok", "f": "txn", "process": i,
+                    "time": t1, "value": mops})
+    return hh.index(out)
+
+
+def test_append_g1c_realtime_stale_future_read():
+    # T0 read x=[2] and COMPLETED before T1 (which appended 2) was even
+    # invoked: WR T1->T0 plus RT T0->T1. Serializable, not strictly so.
+    hist = P((0, 10, [["r", "x", [2]]]),
+             (20, 30, [["append", "x", 2]]))
+    res = ap.check(hist)
+    assert "G1c-realtime" in res["anomaly_types"], res["anomaly_types"]
+    assert res["valid"] is False
+
+
+def test_append_g0_realtime_reversed_version_order():
+    # a read proves 2 precedes 1 in x's order, but 1's appender ran
+    # strictly before 2's: WW T1->T0 + RT T0->T1
+    hist = P((0, 10, [["append", "x", 1]]),
+             (20, 30, [["append", "x", 2]]),
+             (40, 50, [["r", "x", [2, 1]]]))
+    res = ap.check(hist)
+    assert "G0-realtime" in res["anomaly_types"], res["anomaly_types"]
+
+
+def test_append_g_single_realtime():
+    # T2 read x=[1] -- missing 2 -- but 2's appender completed before
+    # T2 was invoked: RW T2->T1 + RT T1->T2
+    hist = P((0, 10, [["append", "x", 1]]),
+             (20, 30, [["append", "x", 2]]),
+             (40, 50, [["r", "x", [1]]]),
+             (60, 70, [["r", "x", [1, 2]]]))
+    res = ap.check(hist)
+    assert "G-single-realtime" in res["anomaly_types"], \
+        res["anomaly_types"]
+
+
+def test_append_g2_realtime_write_skew_with_rt():
+    # two anti-dependencies closed by ONE realtime edge (T_y completed
+    # before T_a began; every other pair overlaps):
+    #   T_a -rw-> T_x -rw-> T_y -rt-> T_a
+    hist = P((0, 100, [["r", "z", []], ["append", "y", 1]]),    # T_y
+             (90, 200, [["r", "y", []], ["append", "x", 1]]),   # T_x
+             (150, 160, [["r", "x", []]]),                      # T_a
+             (300, 310, [["r", "x", [1]], ["r", "y", [1]]]))    # T_r
+    res = ap.check(hist)
+    assert "G2-realtime" in res["anomaly_types"], res["anomaly_types"]
+    assert "G-single-realtime" not in res["anomaly_types"]
+
+
+def test_append_realtime_off_restores_serializable_verdict():
+    hist = P((0, 10, [["r", "x", [2]]]),
+             (20, 30, [["append", "x", 2]]))
+    res = ap.check(hist, {"realtime": False})
+    assert res["valid"] is True
+
+
+def test_wr_lost_update():
+    hist = P((0, 10, [["w", "x", 1]]),
+             (20, 30, [["r", "x", 1], ["w", "x", 2]]),
+             (20, 31, [["r", "x", 1], ["w", "x", 3]]))
+    res = wrx.check(hist)
+    assert "lost-update" in res["anomaly_types"], res["anomaly_types"]
+    assert res["valid"] is False
+
+
+def test_wr_internal():
+    hist = P((0, 10, [["w", "x", 1], ["r", "x", 2]]),)
+    res = wrx.check(hist)
+    assert "internal" in res["anomaly_types"], res["anomaly_types"]
+
+
+def test_wr_g1c_realtime():
+    # read of a value written by a strictly-later txn
+    hist = P((0, 10, [["r", "x", 2]]),
+             (20, 30, [["w", "x", 2]]))
+    res = wrx.check(hist)
+    assert "G1c-realtime" in res["anomaly_types"], res["anomaly_types"]
+
+
+def test_realtime_injection_fuzzer():
+    """Seeded fuzzer: valid filler histories with ONE anomaly pattern
+    injected must always be flagged with (at least) the injected class;
+    uninjected fillers stay valid (VERDICT r2 item 5's done-condition)."""
+    import random as _r
+
+    def filler(base_t, key, vals):
+        """Sequential appends + a confirming read: valid + rt-clean."""
+        txns = []
+        t = base_t
+        for v in vals:
+            txns.append((t, t + 5, [["append", key, v]]))
+            t += 10
+        txns.append((t, t + 5, [["r", key, list(vals)]]))
+        return txns, t + 10
+
+    classes = ["G1c-realtime", "G0-realtime", "G-single-realtime",
+               "lost-update", "internal", None]
+    hits = {c: 0 for c in classes}
+    for seed in range(60):
+        rng = _r.Random(seed)
+        cls = classes[seed % len(classes)]
+        txns, t = filler(0, "f1", [1, 2, 3])
+        more, t = filler(t, "f2", [1, 2])
+        txns += more
+        if cls == "G1c-realtime":
+            txns += [(t, t + 5, [["r", "k", [7]]]),
+                     (t + 10, t + 15, [["append", "k", 7]])]
+        elif cls == "G0-realtime":
+            txns += [(t, t + 5, [["append", "k", 1]]),
+                     (t + 10, t + 15, [["append", "k", 2]]),
+                     (t + 20, t + 25, [["r", "k", [2, 1]]])]
+        elif cls == "G-single-realtime":
+            txns += [(t, t + 5, [["append", "k", 1]]),
+                     (t + 10, t + 15, [["append", "k", 2]]),
+                     (t + 20, t + 25, [["r", "k", [1]]]),
+                     (t + 30, t + 35, [["r", "k", [1, 2]]])]
+        rng.shuffle(txns)
+        if cls in ("lost-update", "internal"):
+            # rw-register flavor
+            wtxns = [(a, b, [[("w" if m[0] == "append" else "r"),
+                              m[1], m[2][-1] if isinstance(m[2], list)
+                              and m[2] else (m[2] if not isinstance(
+                                  m[2], list) else None)]
+                             for m in mops])
+                     for a, b, mops in filler(0, "g1", [1, 2])[0]]
+            if cls == "lost-update":
+                wtxns += [(100, 110, [["w", "k", 1]]),
+                          (120, 130, [["r", "k", 1], ["w", "k", 2]]),
+                          (121, 131, [["r", "k", 1], ["w", "k", 3]])]
+            else:
+                wtxns += [(100, 110, [["w", "k", 1], ["r", "k", 9]])]
+            res = wrx.check(P(*wtxns))
+            assert cls in res["anomaly_types"], (seed, cls, res)
+            hits[cls] += 1
+            continue
+        res = ap.check(P(*txns))
+        if cls is None:
+            assert res["valid"] is True, (seed, res)
+        else:
+            assert cls in res["anomaly_types"], (seed, cls,
+                                                 res["anomaly_types"])
+            hits[cls] += 1
+    assert all(v > 0 for c, v in hits.items() if c is not None)
+
+
+def test_realtime_class_requires_rt_edge_in_witness():
+    """A plain serializability violation must NOT masquerade as a
+    *-realtime anomaly when only realtime classes are requested
+    (advisor finding r3): with no rt edge in any witness cycle, the
+    realtime classes stay silent."""
+    from jepsen_tpu.cycle import RT, RW, WR, Graph, check_graph
+    ops = [{"index": i} for i in range(4)]
+    g = Graph(4)
+    g.add(0, 1, RW)
+    g.add(1, 0, WR)      # plain G-single cycle, no rt involved
+    g.add(2, 3, RT)      # unrelated rt edge elsewhere
+    res = check_graph(g, ops, anomalies=("G-single-realtime",
+                                         "G2-realtime"))
+    assert res["valid"] is True
+    res2 = check_graph(g, ops, anomalies=("G-single",
+                                          "G-single-realtime"))
+    assert res2["anomaly_types"] == ["G-single"]

@@ -874,24 +874,23 @@ def _checkpoint_owned(path, fingerprint):
         return True
 
 
-def _save_checkpoint(path, fingerprint, carry):
-    """Atomically snapshot the search carry (stack, tables, witness
-    trackers, counters) with the input fingerprint."""
+def write_snapshot(path, fingerprint, arrays):
+    """Atomically write a fingerprinted npz snapshot (shared by the
+    single-key and batched checkpoint paths)."""
     import os as _os
-    host = [np.asarray(x) for x in jax.device_get(carry)]
     tmp = f"{path}.tmp"     # np.savez appends .npz to names without it
     _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
     np.savez_compressed(
         tmp,
         fingerprint=np.frombuffer(
             fingerprint.encode(), dtype=np.uint8),
-        **{f"c{i}": x for i, x in enumerate(host)})
+        **arrays)
     _os.replace(f"{tmp}.npz", path)
 
 
-def _load_checkpoint(path, fingerprint):
-    """Load a snapshot if it exists and matches the fingerprint; returns
-    the carry arrays or None."""
+def read_snapshot(path, fingerprint):
+    """Load a fingerprinted snapshot's array dict, or None when the file
+    is absent, corrupt, or belongs to a different check."""
     import os as _os
     if not _os.path.exists(path):
         return None
@@ -900,10 +899,27 @@ def _load_checkpoint(path, fingerprint):
             got = bytes(data["fingerprint"]).decode()
             if got != fingerprint:
                 return None
-            return [data[f"c{i}"]
-                    for i in range(len(data.files) - 1)]
+            return {k: data[k] for k in data.files
+                    if k != "fingerprint"}
     except Exception:  # noqa: BLE001 - corrupt snapshot = start fresh
         return None
+
+
+def _save_checkpoint(path, fingerprint, carry):
+    """Atomically snapshot the search carry (stack, tables, witness
+    trackers, counters) with the input fingerprint."""
+    host = [np.asarray(x) for x in jax.device_get(carry)]
+    write_snapshot(path, fingerprint,
+                   {f"c{i}": x for i, x in enumerate(host)})
+
+
+def _load_checkpoint(path, fingerprint):
+    """Load a snapshot if it exists and matches the fingerprint; returns
+    the carry arrays or None."""
+    data = read_snapshot(path, fingerprint)
+    if data is None:
+        return None
+    return [data[f"c{i}"] for i in range(len(data))]
 
 
 def _interpret(spec, e, out, max_iters, confirm, init_state, perm=None):

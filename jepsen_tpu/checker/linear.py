@@ -113,13 +113,21 @@ def check_encoded(spec, e, init_state, max_configs=100_000, cancel=None):
         if not got:
             result = {"valid": False, "configs_explored": explored,
                       "engine": "linear"}
-            if e.ops is not None:
+            # knossos-parity witness fields from the deepest surviving
+            # prefix, shaped like the other engines' (checker/witness.py
+            # -- competition callers must get the same artifact set no
+            # matter which engine wins the race)
+            if configs:
+                from . import witness
+                lin, skey = max(configs,
+                                key=lambda c: bin(c[0]).count("1"))
+                linearized = np.asarray(
+                    [(lin >> k) & 1 == 1 for k in range(n)], bool)
+                witness.attach(result, spec, e, linearized,
+                               states[skey], init)
+            if "op" not in result and e.ops is not None:
                 inv, comp = e.ops[i]
                 result["op"] = dict(comp if comp is not None else inv)
-            # deepest surviving prefix for the witness
-            if configs:
-                lin, skey = max(configs, key=lambda c: bin(c[0]).count("1"))
-                result["final_state"] = states[skey].tolist()
             return result
         configs = got
     return {"valid": True, "configs_explored": explored,

@@ -58,27 +58,39 @@ DEFAULT_ANOMALIES = ("G0", "G1c", "G-single", "G2") + REALTIME_ANOMALIES
 
 def invocation_times(history):
     """Map id(completion op) -> its invocation time, pairing before
-    callers drop invoke events. Completion-only test histories simply
-    miss entries; callers' ``.get`` fallback treats those ops as point
-    events at their completion time."""
+    callers drop invoke events. Ops without a process (hand-built
+    completion-only test histories) are skipped -- they simply get no
+    entry, which means NO realtime edge can target them (fabricating an
+    order from completion times alone would manufacture strictness no
+    one witnessed)."""
     from .. import history as h
     inv_time = {}
-    for inv, comp in h.pairs(history):
+    paired = [o for o in history if o.get("process") is not None]
+    for inv, comp in h.pairs(paired):
         if inv is not None and comp is not None:
             inv_time[id(comp)] = inv.get("time", comp.get("time", 0))
     return inv_time
 
 
+#: sentinel invocation for ops with unknown invocation times: nothing
+#: can really-precede them
+UNKNOWN_INVOKE = np.int64(2) ** 62
+
+
 def add_realtime_edges(graph, ops, completed_at, invoked_at):
     """Bulk-add RT edges: a -> b iff a COMPLETED before b was INVOKED
-    (the strict-serializability order). Vectorized; per-edge
-    explanations are skipped (the edge name "rt" is self-describing and
-    a dense realtime order would mean O(n^2) strings)."""
+    (the strict-serializability order). ``invoked_at`` returning None
+    means the invocation is unknown: that op gets no incoming RT edge.
+    Vectorized; per-edge explanations are skipped (the edge name "rt"
+    is self-describing and a dense realtime order would mean O(n^2)
+    strings)."""
     if not ops:
         return graph
     comp = np.asarray([completed_at(op) for op in ops], np.int64)
-    inv = np.asarray([invoked_at(op) for op in ops], np.int64)
+    inv = np.asarray([UNKNOWN_INVOKE if (t := invoked_at(op)) is None
+                      else t for op in ops], np.int64)
     rt = comp[:, None] < inv[None, :]
+    rt &= inv[None, :] != UNKNOWN_INVOKE
     np.fill_diagonal(rt, False)
     graph.adj |= np.where(rt, np.uint8(RT), np.uint8(0))
     return graph
@@ -238,7 +250,51 @@ def check_graph(graph: Graph, ops,
     index i (used in witnesses). Returns an elle.core-shaped result:
     {"valid": bool, "anomaly_types": [...], "anomalies": {type: [...]}}"""
     found: dict[str, list] = {}
-    dep_mask = WW | WR | RW
+    rw_edges = np.argwhere(graph.masked(RW))
+
+    def _has_rt(ex):
+        return any("rt" in s["type"].split("+") for s in ex["steps"])
+
+    def rw_pass(base_mask, single_name, g2_name, need_rt,
+                base_closure=None):
+        """G-single/G2-style classification (shared by the plain and
+        realtime variants): for each rw edge (i, j), a return path
+        j ->* i over ``base_mask`` alone means one anti-dependency
+        (single_name); a return path needing further rw edges means >=2
+        (g2_name). ``need_rt`` additionally requires the witness to
+        traverse a realtime edge and defers to the plain class."""
+        want_s = single_name in anomalies and single_name not in found \
+            and not (need_rt and "G-single" in found)
+        want_2 = g2_name in anomalies and g2_name not in found \
+            and not (need_rt and "G2" in found)
+        if not (want_s or want_2) or not len(rw_edges):
+            return
+        # closures are the O(n^3) part; pay only for requested classes
+        base = graph.masked(base_mask)
+        if base_closure is None:
+            base_closure = transitive_closure(base)
+        full = graph.masked(base_mask | RW) if want_2 else None
+        full_closure = transitive_closure(full) if want_2 else None
+        for i, j in rw_edges:
+            i, j = int(i), int(j)
+            if want_s and single_name not in found \
+                    and (base_closure[j, i] or base[j, i]):
+                back = find_path(base, j, i)
+                if back is not None:
+                    ex = _explain_cycle(graph, [i] + back[:-1], ops)
+                    if not need_rt or _has_rt(ex):
+                        found[single_name] = [ex]
+            # checked independently: a history can exhibit both classes
+            if want_2 and g2_name not in found and full_closure[j, i]:
+                back = find_path(full, j, i)
+                if back is not None:
+                    ex = _explain_cycle(graph, [i] + back[:-1], ops)
+                    if ex["rw_count"] >= 2 and (not need_rt
+                                                or _has_rt(ex)):
+                        found[g2_name] = [ex]
+            if (single_name in found or not want_s) \
+                    and (g2_name in found or not want_2):
+                break
 
     # G0: ww-only cycles
     if "G0" in anomalies:
@@ -252,37 +308,7 @@ def check_graph(graph: Graph, ops,
         if cyc:
             found["G1c"] = [_explain_cycle(graph, cyc, ops)]
 
-    # G-single / G2: cycles with anti-dependency edges. For each rw edge
-    # (i, j): a ww|wr path j ->* i makes it G-single; any dependency path
-    # j ->* i makes it at least G2.
-    want_single = "G-single" in anomalies
-    want_g2 = "G2" in anomalies
-    rw_edges = np.argwhere(graph.masked(RW))
-    if (want_single or want_g2) and len(rw_edges):
-        # closures are the O(n^3) part; only pay for them when rw edges
-        # exist and the corresponding anomaly class was requested
-        wwr = graph.masked(WW | WR)
-        wwr_closure = transitive_closure(wwr)
-        dep = graph.masked(dep_mask)
-        full = transitive_closure(dep) if want_g2 else None
-        for i, j in rw_edges:
-            i, j = int(i), int(j)
-            # one rw + a ww/wr return path -> G-single
-            if want_single and "G-single" not in found \
-                    and (wwr_closure[j, i] or wwr[j, i]):
-                back = find_path(wwr, j, i)
-                if back is not None:
-                    cyc = [i] + back[:-1]
-                    found["G-single"] = [_explain_cycle(graph, cyc, ops)]
-            # a return path that itself needs rw edges -> G2. Checked
-            # independently of G-single: a history can exhibit both.
-            if want_g2 and "G2" not in found and full[j, i]:
-                back = find_path(dep, j, i)
-                if back is not None:
-                    cyc = [i] + back[:-1]
-                    ex = _explain_cycle(graph, cyc, ops)
-                    if ex["rw_count"] >= 2:
-                        found["G2"] = [ex]
+    rw_pass(WW | WR, "G-single", "G2", need_rt=False)
 
     # strict-serializability classes: cycles that genuinely need a
     # realtime edge. Only searched when RT edges exist, only when the
@@ -291,54 +317,25 @@ def check_graph(graph: Graph, ops,
     # serializability violation would masquerade as strictly-weaker.
     want_rt = [a for a in anomalies if a.endswith("-realtime")]
     if want_rt and graph.masked(RT).any():
-        want_single_rt = "G-single-realtime" in anomalies \
-            and "G-single" not in found
-        ext = graph.masked(WW | WR | RT)
-        ext_closure = transitive_closure(ext)
-
-        def has_rt(ex):
-            return any("rt" in s["type"].split("+") for s in ex["steps"])
-
-        if ("G0-realtime" in anomalies or "G1c-realtime" in anomalies) \
-                and not ("G0" in found or "G1c" in found):
-            cyc = _first_cycle(graph, WW | WR | RT, require=RT,
+        ext_closure = transitive_closure(graph.masked(WW | WR | RT))
+        # searched per class (like the plain G0/G1c passes), so a
+        # requested class is never shadowed by its sibling's witness
+        if "G0-realtime" in anomalies and "G0" not in found:
+            cyc = _first_cycle(graph, WW | RT, require=RT)
+            if cyc:
+                ex = _explain_cycle(graph, cyc, ops)
+                if _has_rt(ex):
+                    found["G0-realtime"] = [ex]
+        if "G1c-realtime" in anomalies and "G1c" not in found \
+                and "G0-realtime" not in found:
+            cyc = _first_cycle(graph, WW | WR | RT, require=WR,
                                closure=ext_closure)
             if cyc:
                 ex = _explain_cycle(graph, cyc, ops)
-                has_wr = any("wr" in s["type"].split("+")
-                             for s in ex["steps"])
-                name = "G1c-realtime" if has_wr else "G0-realtime"
-                if name in anomalies and has_rt(ex):
-                    found[name] = [ex]
-        want_g2_rt = "G2-realtime" in anomalies and "G2" not in found
-        if (want_single_rt or want_g2_rt) and len(rw_edges):
-            # G-single-realtime: the rw edge's return path avoids other
-            # rw edges; G2-realtime: the return path may (must) use them
-            full_rt = graph.masked(WW | WR | RW | RT) if want_g2_rt \
-                else None
-            full_rt_closure = (transitive_closure(full_rt)
-                               if want_g2_rt else None)
-            for i, j in rw_edges:
-                i, j = int(i), int(j)
-                if want_single_rt and "G-single-realtime" not in found \
-                        and (ext_closure[j, i] or ext[j, i]):
-                    back = find_path(ext, j, i)
-                    if back is not None:
-                        cyc = [i] + back[:-1]
-                        ex = _explain_cycle(graph, cyc, ops)
-                        if has_rt(ex):
-                            found["G-single-realtime"] = [ex]
-                if want_g2_rt and "G2-realtime" not in found \
-                        and full_rt_closure[j, i]:
-                    back = find_path(full_rt, j, i)
-                    if back is not None:
-                        cyc = [i] + back[:-1]
-                        ex = _explain_cycle(graph, cyc, ops)
-                        if ex["rw_count"] >= 2 and has_rt(ex):
-                            found["G2-realtime"] = [ex]
-                if ("G-single-realtime" in found or not want_single_rt) \
-                        and ("G2-realtime" in found or not want_g2_rt):
-                    break
+                if _has_rt(ex):
+                    found["G1c-realtime"] = [ex]
+        rw_pass(WW | WR | RT, "G-single-realtime", "G2-realtime",
+                need_rt=True, base_closure=ext_closure)
     return {"valid": not found,
             "anomaly_types": sorted(found),
             "anomalies": found}

@@ -194,7 +194,7 @@ def analyze(history, anomalies=DEFAULT_ANOMALIES,
     if realtime:
         add_realtime_edges(
             graph, oks, lambda op: op.get("time", 0),
-            lambda op: inv_time.get(id(op), op.get("time", 0)))
+            lambda op: inv_time.get(id(op)))
 
     res = check_graph(graph, oks, anomalies)
     res["anomalies"].update(found)

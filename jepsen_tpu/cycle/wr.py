@@ -201,8 +201,12 @@ def analyze(history, opts=None) -> dict:
         # strict-serializability: a completed-before-invoked pair is
         # realtime-ordered; cycles needing these edges become the
         # *-realtime anomaly classes
+        # unlike linearizable_keys' precedes() (whose point-event
+        # fallback is documented, opt-in behavior), RT edges are only
+        # added where a real invocation was witnessed
         add_realtime_edges(graph, oks,
-                           lambda op: op.get("time", 0), invoked_at)
+                           lambda op: op.get("time", 0),
+                           lambda op: inv_time.get(id(op)))
 
     res = check_graph(graph, oks, anomalies)
     res["anomalies"].update(found)

@@ -588,7 +588,7 @@ def test_invalid_check_carries_knossos_witness_fields():
         inv(1, "read"), ok(1, "read", 2),     # before write 2 begins
         inv(0, "write", 2), ok(0, "write", 2),
     ]
-    for algo in ("wgl", "jax-wgl"):
+    for algo in ("wgl", "jax-wgl", "linear"):
         c = ck.linearizable({"model": "cas-register", "algorithm": algo})
         r = check(c, bad)
         assert r["valid"] is False

@@ -555,3 +555,16 @@ def test_realtime_class_requires_rt_edge_in_witness():
     res2 = check_graph(g, ops, anomalies=("G-single",
                                           "G-single-realtime"))
     assert res2["anomaly_types"] == ["G-single"]
+
+
+def test_completion_only_histories_get_no_realtime_edges():
+    """Ops without witnessed invocations never gain RT edges (advisor
+    finding r3: completion times alone cannot prove realtime order),
+    and process-less minimal histories don't crash the pairing."""
+    hist = H([["r", "x", [2]]],
+             [["append", "x", 2]])
+    res = ap.analyze(hist)           # ok-only: serializable, no RT
+    assert res["valid"] is True
+    minimal = [{"type": "ok", "f": "txn", "index": 0,
+                "value": [["append", "x", 1]]}]
+    assert ap.analyze(minimal)["valid"] is True

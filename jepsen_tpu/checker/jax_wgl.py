@@ -125,6 +125,13 @@ KEYED = (0, 1, 2, 4, 5, 6, 7, 8, 9, 10)
 #: build are cleanly ignored instead of crashing the resume
 CARRY_LAYOUT = f"carry-v3:tab-interleaved,probes{PROBES}"
 
+#: carry tuple indices (v3 layout; single source of truth for every
+#: consumer -- hardcoded copies desynchronized once already when v2's
+#: split tables were merged)
+(IDX_BUF_LIN, IDX_BUF_STATE, IDX_TOP, IDX_TAB, IDX_DROPPED, IDX_STATUS,
+ IDX_EXPLORED, IDX_BEST_DEPTH, IDX_BEST_LIN, IDX_BEST_STATE, IDX_ITS,
+ IDX_IT, IDX_CLAIM) = range(13)
+
 
 @functools.lru_cache(maxsize=64)
 def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
@@ -537,8 +544,9 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
         consts = (invoke, ret, fop, args, rets, ok_words, salt, bound)
 
         def cond(c):
-            return jnp.any((c[5] == RUNNING) & (c[2] > 0)) \
-                & (c[11][0] < bound)
+            return jnp.any((c[IDX_STATUS] == RUNNING)
+                           & (c[IDX_TOP] > 0)) \
+                & (c[IDX_IT][0] < bound)
 
         return lax.while_loop(cond, lambda c: body(c, consts), carry)
 
@@ -811,12 +819,13 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     t0 = _time.monotonic()
     last_ckpt = t0
     timed_out = False
-    it = int(carry[11][0])
+    it = int(carry[IDX_IT][0])
     while True:
         bound = min(it + chunk_iters, max_iters)
         carry = run_chunk(carry, *consts, jnp.int32(bound))
-        status, top, it = (int(carry[5][0]), int(carry[2][0]),
-                           int(carry[11][0]))
+        status, top, it = (int(carry[IDX_STATUS][0]),
+                           int(carry[IDX_TOP][0]),
+                           int(carry[IDX_IT][0]))
         if status != RUNNING or top == 0 or it >= max_iters:
             break
         now = _time.monotonic()
@@ -831,10 +840,13 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
                 _save_checkpoint(checkpoint, fingerprint, carry)
             break
 
-    out = {"status": carry[5][0], "top": carry[2][0],
-           "dropped": carry[4][0], "explored": carry[6][0],
-           "iterations": carry[10][0], "best_depth": carry[7][0],
-           "best_lin": carry[8][0], "best_state": carry[9][0]}
+    out = {"status": carry[IDX_STATUS][0], "top": carry[IDX_TOP][0],
+           "dropped": carry[IDX_DROPPED][0],
+           "explored": carry[IDX_EXPLORED][0],
+           "iterations": carry[IDX_ITS][0],
+           "best_depth": carry[IDX_BEST_DEPTH][0],
+           "best_lin": carry[IDX_BEST_LIN][0],
+           "best_state": carry[IDX_BEST_STATE][0]}
     out = jax.device_get(out)
     if timed_out and int(out["status"]) == RUNNING and int(out["top"]) > 0:
         return {"valid": "unknown", "error": "timeout",

@@ -31,7 +31,10 @@ import jax
 import jax.numpy as jnp
 
 from ..checker import jax_wgl
-from ..checker.jax_wgl import (INF32, KEYED, RUNNING, _bucket, _build_search,
+from ..checker.jax_wgl import (IDX_BEST_DEPTH, IDX_BEST_LIN,
+                               IDX_BEST_STATE, IDX_DROPPED, IDX_EXPLORED,
+                               IDX_ITS, IDX_STATUS, IDX_TOP, INF32, KEYED,
+                               RUNNING, _bucket, _build_search,
                                _encode_arrays, _plan_sizes,
                                max_point_concurrency)
 from ..history import INF_TIME
@@ -270,10 +273,13 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     timed_out = False
 
     def harvest(rows, carry):
-        fields = {"status": carry[5], "top": carry[2], "dropped": carry[4],
-                  "explored": carry[6], "iterations": carry[10],
-                  "best_depth": carry[7], "best_lin": carry[8],
-                  "best_state": carry[9]}
+        fields = {"status": carry[IDX_STATUS], "top": carry[IDX_TOP],
+                  "dropped": carry[IDX_DROPPED],
+                  "explored": carry[IDX_EXPLORED],
+                  "iterations": carry[IDX_ITS],
+                  "best_depth": carry[IDX_BEST_DEPTH],
+                  "best_lin": carry[IDX_BEST_LIN],
+                  "best_state": carry[IDX_BEST_STATE]}
         got = jax.device_get(fields)
         for r in rows:
             if alive[r] >= 0:
@@ -292,15 +298,15 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         t_chunk = _time.monotonic()
         carry = run_b(carry, *consts, jnp.int32(bound))
         it = bound
-        status = np.asarray(carry[5])
+        status = np.asarray(carry[IDX_STATUS])
         if logger.isEnabledFor(logging.DEBUG):
             logger.debug(
                 "chunk to it=%d: %.3fs, K=%d running=%d", it,
                 _time.monotonic() - t_chunk, len(alive),
-                int(((status == RUNNING) & (np.asarray(carry[2]) > 0)
-                     ).sum()))
-        top = np.asarray(carry[2])
-        its = np.asarray(carry[10])
+                int(((status == RUNNING)
+                     & (np.asarray(carry[IDX_TOP]) > 0)).sum()))
+        top = np.asarray(carry[IDX_TOP])
+        its = np.asarray(carry[IDX_ITS])
         running = (status == RUNNING) & (top > 0) & (its < max_iters)
         n_run = int(running.sum())
         if n_run == 0:

@@ -236,7 +236,10 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
         top = start
 
         # -- candidate selection (the WGL rule) -----------------------------
-        wbits = jnp.take(lin, word_idx, axis=2)               # (K,W,n)
+        # word_idx[i] == i // 32 exactly, so the word gather is a
+        # gather-free repeat + slice (TPU gathers are the kernel's
+        # slowest ops; see PROFILE.md)
+        wbits = jnp.repeat(lin, 32, axis=2)[:, :, :n]          # (K,W,n)
         unlin = ((wbits >> bit_idx[None, None, :]) & jnp.uint32(1)) == 0
         rmin = jnp.min(jnp.where(unlin, ret[:, None, :], INF32), axis=2)
         cand = unlin & (invoke[:, None, :] < rmin[..., None]) \
@@ -357,7 +360,7 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
 
         def roll_step(rc_, _):
             lin_r, st_r, alive = rc_
-            wb = jnp.take(lin_r, word_idx, axis=1)            # (K,n)
+            wb = jnp.repeat(lin_r, 32, axis=1)[:, :n]         # (K,n)
             unl = ((wb >> bit_idx[None, :]) & jnp.uint32(1)) == 0
             rm = jnp.min(jnp.where(unl, ret, INF32), axis=1)  # (K,)
             elig = unl & (invoke < rm[:, None])

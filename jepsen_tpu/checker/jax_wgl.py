@@ -65,6 +65,12 @@ INF32 = np.int32(2**31 - 1)
 #: width of the original 8 at no measured dedup-quality cost)
 PROBES = 4
 
+#: deepest-distinct-config witness slots per key. knossos returns up to
+#: 10 stuck :configs (reference checker.clj:213-216 truncates a list);
+#: round 3 tracked exactly one, so the truncation guard could never
+#: fire. 8 slots make the masked-reduction update one vector op wide.
+TOPK = 8
+
 
 # ---------------------------------------------------------------------------
 # host-side helpers
@@ -123,9 +129,9 @@ KEYED = (0, 1, 2, 4, 5, 6, 7, 8, 9, 10)
 #: version tag hashed into checkpoint fingerprints: bump whenever the
 #: carry layout or table format changes, so snapshots from an older
 #: build are cleanly ignored instead of crashing the resume
-CARRY_LAYOUT = f"carry-v3:tab-interleaved,probes{PROBES}"
+CARRY_LAYOUT = f"carry-v4:tab-interleaved,probes{PROBES},topk{TOPK}"
 
-#: carry tuple indices (v3 layout; single source of truth for every
+#: carry tuple indices (v4 layout; single source of truth for every
 #: consumer -- hardcoded copies desynchronized once already when v2's
 #: split tables were merged)
 (IDX_BUF_LIN, IDX_BUF_STATE, IDX_TOP, IDX_TAB, IDX_DROPPED, IDX_STATUS,
@@ -134,7 +140,8 @@ CARRY_LAYOUT = f"carry-v3:tab-interleaved,probes{PROBES}"
 
 
 @functools.lru_cache(maxsize=64)
-def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
+def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
+                  NS=None):
     """Compile the search for one shape bundle with an explicit key-batch
     axis K (jepsen.independent keys, BASELINE config 2). Returns jitted
 
@@ -153,9 +160,10 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
     interleaved so one gather fetches both words -- the two separate
     tables cost a second 590k-row gather per iteration, the kernel's
     single biggest op), dropped (K,) bool, status (K,)
-    i32, explored (K,) i32, best_depth (K,) i32, best_lin (K,B) u32,
-    best_state (K,S) i32, its (K,) i32, it (G,) i32, claim (G,Tc) i32
-    shared. G is the table-group count: 1 locally; under shard_map over a
+    i32, explored (K,) i32, best_depth (K,TOPK) i32, best_lin (K,TOPK,B)
+    u32, best_state (K,TOPK,S) i32 (TOPK distinct deepest-config witness
+    slots, knossos's multi-:configs parity), its (K,) i32, it (G,) i32,
+    claim (G,Tc) i32 shared. G is the table-group count: 1 locally; under shard_map over a
     mesh, G = mesh size so each device shard sees exactly one group (the
     body always indexes group 0 of its local view). Buffers depend on O/B/S/T but NOT on W, so kernel variants with
     different frontier widths are interchangeable mid-search (the batch
@@ -180,7 +188,15 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
         # -- 200-op histories per key -- grinding one depth level per
         # iteration; lowering it to 64 cut rung 2 device time ~3x.)
         R = 0 if n <= 64 else min(256, n)
-    ML = M + R
+    if NS is None:
+        # Greedy chains rolled per iteration. The scan chain is LATENCY-
+        # bound (PROFILE.md rung 5: 67 us/micro-step on O(n) values), so
+        # widening each micro-step to NS seeds is nearly free while
+        # multiplying depth progress wherever the single chain wedges on
+        # a plateau (round 3 rolled exactly one DFS-top seed; VERDICT r3
+        # weak #2). Seeds are the top-NS children in DFS order.
+        NS = 8 if R else 1
+    ML = M + NS * R
     KML = K * ML
     Tc = 1 << 16   # twin-claim scratch; fixed so carries are W-independent
 
@@ -189,9 +205,11 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
     step_vvv = jax.vmap(jax.vmap(jax.vmap(
         step_one, in_axes=(None, 0, 0, 0)), in_axes=(0, 0, 0, 0)),
         in_axes=(0, 0, 0, 0))
-    # vmap over all n ops from one state, then keys (rollout)
-    step_vn = jax.vmap(jax.vmap(
-        step_one, in_axes=(None, 0, 0, 0)), in_axes=(0, 0, 0, 0))
+    # vmap over all n ops from one state, then NS seed chains (ops
+    # shared), then keys (rollout)
+    step_vn = jax.vmap(jax.vmap(jax.vmap(
+        step_one, in_axes=(None, 0, 0, 0)), in_axes=(0, None, None, None)),
+        in_axes=(0, 0, 0, 0))
 
     def fingerprint(words):
         """words: (KM, B+S+1) uint32 -> two (KM,) uint32 hashes.
@@ -300,28 +318,42 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
         # argmax + take_along_axis: the per-key gathers lowered to
         # serialized scalar-memory fusions costing ~15 ms/iteration at
         # K=256 (profiled; see PROFILE.md), the masked reduction is a
-        # plain vector op.
+        # plain vector op. Each update site inserts the iteration's best
+        # candidate into TOPK distinct deepest-config slots.
+        def topk_insert(bd3, bl3, bs3, cd, cl, cs):
+            """Insert one candidate config per key (cd (K,), cl (K,B),
+            cs (K,S)) into the TOPK distinct-deepest slots. Eviction
+            replaces a min-depth slot; ``>=`` admits equal-depth DISTINCT
+            configs (a stuck frontier is many configs at one max depth),
+            and a max-depth slot can only be evicted by an equally deep
+            distinct config, so the deepest witness is never lost."""
+            dup = ((bl3 == cl[:, None, :]).all(-1)
+                   & (bs3 == cs[:, None, :]).all(-1)
+                   & (bd3 >= 0)).any(axis=1)                  # (K,)
+            mind = jnp.min(bd3, axis=1)                       # (K,)
+            do = (cd >= 0) & (cd >= mind) & ~dup
+            sloteq = bd3 == mind[:, None]                     # (K,TOPK)
+            spk = (sloteq
+                   & (jnp.cumsum(sloteq.astype(jnp.int32), axis=1) == 1)
+                   & do[:, None])
+            return (jnp.where(spk, cd[:, None], bd3),
+                    jnp.where(spk[..., None], cl[:, None, :], bl3),
+                    jnp.where(spk[..., None], cs[:, None, :], bs3))
+
         depth = lax.population_count(lin2 & okw).sum(axis=-1) \
             .astype(jnp.int32)
         depth = jnp.where(child_valid, depth, -1).reshape(K, M)
         bd = jnp.max(depth, axis=1)                           # (K,)
-        better = bd > best_depth
-        best_depth = jnp.where(better, bd, best_depth)
         lin2k = lin2.reshape(K, M, B)
         st2k = st2.reshape(K, M, S)
         eq = depth == bd[:, None]
-        pick = (eq & (jnp.cumsum(eq.astype(jnp.int32), axis=1) == 1)
-                & better[:, None])                            # (K,M)
-        best_lin = jnp.where(
-            better[:, None],
-            jnp.sum(jnp.where(pick[..., None], lin2k, 0), axis=1,
-                    dtype=jnp.uint32),
-            best_lin)
-        best_state = jnp.where(
-            better[:, None],
-            jnp.sum(jnp.where(pick[..., None], st2k, 0), axis=1,
-                    dtype=jnp.int32),
-            best_state)
+        pick = eq & (jnp.cumsum(eq.astype(jnp.int32), axis=1) == 1)
+        cand_lin = jnp.sum(jnp.where(pick[..., None], lin2k, 0), axis=1,
+                           dtype=jnp.uint32)                  # (K,B)
+        cand_st = jnp.sum(jnp.where(pick[..., None], st2k, 0), axis=1,
+                          dtype=jnp.int32)                    # (K,S)
+        best_depth, best_lin, best_state = topk_insert(
+            best_depth, best_lin, best_state, bd, cand_lin, cand_st)
 
         # -- greedy rollout -------------------------------------------------
         # Branch-and-bound advances depth at most 1 per iteration, and
@@ -335,51 +367,68 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
         # chain configs are pushed (deepest on top) and deduped like any
         # others, so backtracking still explores alternatives around any
         # step the greedy choice got wrong.
-        # Seed from the DFS-TOP child: the deepest popped parent's best-
-        # priority surviving child -- exactly what plain DFS would pop
-        # next (parents are popped in w-ascending = shallowest-first
-        # order, candidates in c-ascending = priority order). Seeding
-        # from argmax-depth instead ties toward the FIRST max-depth
-        # lane, i.e. some shallow parent's plateau child, whose state
-        # wedges the chain immediately on brittle models (FIFO: an
-        # equal-depth config with the wrong queue contents is a dead
-        # end; measured as the chain advancing ~1 level/iteration).
+        # Seed from the top-NS children in DFS order: the deepest popped
+        # parent's best-priority surviving children first -- exactly what
+        # plain DFS would pop next (parents are popped in w-ascending =
+        # shallowest-first order, candidates in c-ascending = priority
+        # order). Seeding from argmax-depth instead ties toward the
+        # FIRST max-depth lane, i.e. some shallow parent's plateau
+        # child, whose state wedges the chain immediately on brittle
+        # models (FIFO: an equal-depth config with the wrong queue
+        # contents is a dead end; measured as the chain advancing ~1
+        # level/iteration). NS > 1 chains diversify around exactly the
+        # choice points where one greedy chain wedges: the seeds differ
+        # in which candidate linearizes at the current deepest level.
+        # Selection is NS unrolled masked-max reductions over (K, M) --
+        # no sort, no gather (dfs_rank values are distinct per lane, so
+        # each "== smax" one-hot hits exactly one lane).
         dfs_rank = (arange_W[:, None] * C
                     + (C - 1 - arange_C)[None, :]).reshape(M)   # (M,)
         score = jnp.where(child_valid.reshape(K, M),
                           dfs_rank[None, :], -1)
-        smax = jnp.max(score, axis=1)                          # (K,)
-        seed_ok = running & (smax >= 0)
-        seq = score == smax[:, None]
-        spick = seq & (jnp.cumsum(seq.astype(jnp.int32), axis=1) == 1) \
-            & seed_ok[:, None]                                 # (K,M)
-        seed_lin = jnp.sum(jnp.where(spick[..., None], lin2k, 0),
-                           axis=1, dtype=jnp.uint32)          # (K,B)
-        seed_st = jnp.sum(jnp.where(spick[..., None], st2k, 0),
-                          axis=1, dtype=jnp.int32)            # (K,S)
+        seed_lin_l, seed_st_l, seed_ok_l = [], [], []
+        for _s in range(NS):
+            smax = jnp.max(score, axis=1)                      # (K,)
+            ok_s = running & (smax >= 0)
+            seq = score == smax[:, None]
+            spick = seq & (jnp.cumsum(seq.astype(jnp.int32), axis=1)
+                           == 1) & ok_s[:, None]               # (K,M)
+            seed_lin_l.append(jnp.sum(
+                jnp.where(spick[..., None], lin2k, 0), axis=1,
+                dtype=jnp.uint32))
+            seed_st_l.append(jnp.sum(
+                jnp.where(spick[..., None], st2k, 0), axis=1,
+                dtype=jnp.int32))
+            seed_ok_l.append(ok_s)
+            score = jnp.where(spick, -1, score)
+        seed_lin = jnp.stack(seed_lin_l, axis=1)               # (K,NS,B)
+        seed_st = jnp.stack(seed_st_l, axis=1)                 # (K,NS,S)
+        seed_ok = jnp.stack(seed_ok_l, axis=1)                 # (K,NS)
 
         def roll_step(rc_, _):
-            lin_r, st_r, alive = rc_
-            wb = jnp.repeat(lin_r, 32, axis=1)[:, :n]         # (K,n)
-            unl = ((wb >> bit_idx[None, :]) & jnp.uint32(1)) == 0
-            rm = jnp.min(jnp.where(unl, ret, INF32), axis=1)  # (K,)
-            elig = unl & (invoke < rm[:, None])
-            stn, okn = step_vn(st_r, fop, args, rets)         # (K,n,S)
-            succ = elig & okn & alive[:, None]
+            lin_r, st_r, alive = rc_                        # (K,NS,B) ...
+            wb = jnp.repeat(lin_r, 32, axis=2)[:, :, :n]      # (K,NS,n)
+            unl = ((wb >> bit_idx[None, None, :]) & jnp.uint32(1)) == 0
+            rm = jnp.min(jnp.where(unl, ret[:, None, :], INF32),
+                         axis=2)                              # (K,NS)
+            elig = unl & (invoke[:, None, :] < rm[..., None])
+            stn, okn = step_vn(st_r, fop, args, rets)       # (K,NS,n,S)
+            succ = elig & okn & alive[..., None]
             # first succeeding op in index order = best priority (ops are
             # pre-sorted by the linearization hint)
-            j = jnp.argmax(succ, axis=1).astype(jnp.int32)    # (K,)
-            took = succ.any(axis=1)
+            j = jnp.argmax(succ, axis=2).astype(jnp.int32)    # (K,NS)
+            took = succ.any(axis=2)
             wsel = jnp.take(word_idx, j)
-            bmask = (arange_B[None, :]
-                     == wsel[:, None].astype(jnp.uint32))
+            bmask = (arange_B[None, None, :]
+                     == wsel[..., None].astype(jnp.uint32))
             newlin = lin_r | jnp.where(
-                bmask & took[:, None],
-                jnp.uint32(1) << jnp.take(bit_idx, j)[:, None],
+                bmask & took[..., None],
+                jnp.uint32(1) << jnp.take(bit_idx, j)[..., None],
                 jnp.uint32(0))
             newst = jnp.where(
-                took[:, None],
-                jnp.take_along_axis(stn, j[:, None, None], axis=1)[:, 0]
+                took[..., None],
+                jnp.take_along_axis(stn, j[..., None, None],
+                                    axis=2)[:, :, 0]
                 .astype(jnp.int32), st_r)
             alive = alive & took
             return (newlin, newst, alive), (newlin, newst, alive)
@@ -387,9 +436,16 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
         if R:
             _, (ch_lin, ch_st, ch_alive) = lax.scan(
                 roll_step, (seed_lin, seed_st, seed_ok), None, length=R)
-            ch_lin = jnp.moveaxis(ch_lin, 0, 1)               # (K,R,B)
-            ch_st = jnp.moveaxis(ch_st, 0, 1)                 # (K,R,S)
-            ch_alive = jnp.moveaxis(ch_alive, 0, 1)           # (K,R)
+            # (R,K,NS,*) -> (K,NS,R,*); flip the seed axis so the BEST
+            # seed's chain flattens to the LAST lanes (= top of stack,
+            # its deepest config on the very top), then fold seeds into
+            # one chain-lane axis of NS*R
+            ch_lin = jnp.transpose(ch_lin, (1, 2, 0, 3))[:, ::-1] \
+                .reshape(K, NS * R, B)
+            ch_st = jnp.transpose(ch_st, (1, 2, 0, 3))[:, ::-1] \
+                .reshape(K, NS * R, S)
+            ch_alive = jnp.transpose(ch_alive, (1, 2, 0))[:, ::-1] \
+                .reshape(K, NS * R)
 
             okw2 = ok_words[:, None, :]
             ch_done = jnp.all((ch_lin & okw2) == okw2, axis=-1) & ch_alive
@@ -399,23 +455,17 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
                 ch_alive,
                 lax.population_count(ch_lin & okw2).sum(-1)
                 .astype(jnp.int32),
-                -1)                                           # (K,R)
+                -1)                                           # (K,NS*R)
             cbd = jnp.max(ch_depth, axis=1)
-            cbetter = cbd > best_depth
-            best_depth = jnp.where(cbetter, cbd, best_depth)
             ceq = ch_depth == cbd[:, None]
-            cpick = (ceq & (jnp.cumsum(ceq.astype(jnp.int32), axis=1)
-                            == 1) & cbetter[:, None])         # (K,R)
-            best_lin = jnp.where(
-                cbetter[:, None],
-                jnp.sum(jnp.where(cpick[..., None], ch_lin, 0), axis=1,
-                        dtype=jnp.uint32),
-                best_lin)
-            best_state = jnp.where(
-                cbetter[:, None],
-                jnp.sum(jnp.where(cpick[..., None], ch_st, 0), axis=1,
-                        dtype=jnp.int32),
-                best_state)
+            cpick = ceq & (jnp.cumsum(ceq.astype(jnp.int32), axis=1)
+                           == 1)                              # (K,NS*R)
+            cc_lin = jnp.sum(jnp.where(cpick[..., None], ch_lin, 0),
+                             axis=1, dtype=jnp.uint32)
+            cc_st = jnp.sum(jnp.where(cpick[..., None], ch_st, 0),
+                            axis=1, dtype=jnp.int32)
+            best_depth, best_lin, best_state = topk_insert(
+                best_depth, best_lin, best_state, cbd, cc_lin, cc_st)
 
         # -- combined lanes (expansion then chain, natural order) -----------
         # Stack positions are assigned ARITHMETICALLY below so lane data
@@ -531,8 +581,10 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None):
                 jnp.zeros((G, T, 2), jnp.uint32),
                 jnp.zeros(K, bool), jnp.full(K, RUNNING),
                 jnp.zeros(K, jnp.int32),
-                jnp.full(K, -1, jnp.int32), jnp.zeros((K, B), jnp.uint32),
-                jnp.zeros((K, S), jnp.int32), jnp.zeros(K, jnp.int32),
+                jnp.full((K, TOPK), -1, jnp.int32),
+                jnp.zeros((K, TOPK, B), jnp.uint32),
+                jnp.zeros((K, TOPK, S), jnp.int32),
+                jnp.zeros(K, jnp.int32),
                 jnp.zeros(G, jnp.int32), jnp.zeros((G, Tc), jnp.int32))
 
     def run_chunk(carry, invoke, ret, fop, args, rets, ok_words, salt,
@@ -964,19 +1016,33 @@ def _interpret(spec, e, out, max_iters, confirm, init_state, perm=None):
 
 
 def _attach_witness(result, e, out, perm, spec, init_state):
-    """Decode the deepest stuck configuration into knossos-style
-    witness fields (op / final_paths / previous_ok / configs, see
-    checker/witness.py). Bit positions are in priority-sorted space;
-    perm maps them back to original op indices."""
-    lin = np.asarray(out["best_lin"], np.uint32)
+    """Decode the TOPK deepest distinct stuck configurations into
+    knossos-style witness fields (op / final_paths / previous_ok /
+    configs, see checker/witness.py; knossos returns a LIST of stuck
+    :configs, reference checker.clj:213-216). Bit positions are in
+    priority-sorted space; perm maps them back to original op
+    indices."""
+    depths = np.asarray(out["best_depth"], np.int32).reshape(-1)
+    lins = np.asarray(out["best_lin"], np.uint32).reshape(len(depths), -1)
+    states = np.asarray(out["best_state"],
+                        np.int32).reshape(len(depths), -1)
     n = len(e)
-    linearized = np.zeros(n, bool)
-    for i in range(n):
-        pos = int(perm[i]) if perm is not None else i
-        linearized[pos] = bool((lin[i // 32] >> np.uint32(i % 32)) & 1)
+    slots = []
+    for s in np.argsort(-depths, kind="stable"):
+        if depths[s] < 0:
+            continue
+        lin = lins[s]
+        linearized = np.zeros(n, bool)
+        for i in range(n):
+            pos = int(perm[i]) if perm is not None else i
+            linearized[pos] = bool((lin[i // 32] >> np.uint32(i % 32)) & 1)
+        slots.append((linearized, states[s]))
+    if not slots:
+        # no child ever linearized (the search wedged at the root):
+        # the root config IS the stuck config
+        slots = [(np.zeros(n, bool), np.asarray(init_state, np.int32))]
     from . import witness
-    witness.attach(result, spec, e, linearized,
-                   np.asarray(out["best_state"]), init_state)
+    witness.attach_multi(result, spec, e, slots, init_state)
 
 
 def check_history(spec, history, **kw):

@@ -117,17 +117,17 @@ def check_encoded(spec, e, init_state, max_configs=None, cancel=None):
     # (op / final_paths / previous_ok / configs -- see checker/witness.py)
     result = {"valid": False, "configs_explored": explored}
     if best_configs:
+        # the oracle tracks several distinct deepest configs; decode them
+        # through the same multi-config path as the device engine's TOPK
+        # slots so the two witness shapes can never drift
         from . import witness
-        lin0, state0 = best_configs[0]
-        linearized = np.zeros(n, bool)
-        for i in range(n):
-            linearized[i] = bool((lin0 >> i) & 1)
-        witness.attach(result, spec, e, linearized, state0, init_state)
-        # the oracle tracks several distinct deepest configs; report the
-        # extras' model states alongside the fully-decoded primary one
-        for _lin, state in best_configs[1:]:
-            result["configs"].append(
-                {"model": witness._decode_state(spec, state)})
+        slots = []
+        for lin_x, state in best_configs:
+            lx = np.zeros(n, bool)
+            for i in range(n):
+                lx[i] = bool((lin_x >> i) & 1)
+            slots.append((lx, state))
+        witness.attach_multi(result, spec, e, slots, init_state)
     return result
 
 

@@ -170,10 +170,23 @@ def _decode_state(spec, state):
     return state.tolist()
 
 
+def config_entry(spec, e, linearized, state, last_op=None):
+    """One knossos-style stuck-config map: the model state plus the ops
+    still open under the WGL rule at this configuration (invoked before
+    every unlinearized return)."""
+    rets = np.asarray(e.return_idx, np.int64)
+    invoke = np.asarray(e.invoke_idx, np.int64)
+    un = ~np.asarray(linearized, bool)
+    rmin = rets[un].min() if un.any() else INF_TIME
+    pending = np.flatnonzero(un & (invoke < rmin))
+    return {"model": _decode_state(spec, state),
+            "last_op": last_op,
+            "pending": [_decode_op(e, int(i)) for i in pending[:16]]}
+
+
 def attach(result, spec, e, linearized, best_state, init_state):
     """Shape knossos-style witness fields onto ``result`` (mutates and
     returns it). ``linearized``: bool[n] of the deepest configuration."""
-    n = len(e)
     linearized = np.asarray(linearized, bool)
     is_ok = np.asarray(e.is_ok, bool)
     stuck = np.flatnonzero(is_ok & ~linearized)
@@ -194,18 +207,23 @@ def attach(result, spec, e, linearized, best_state, init_state):
             (_decode_op(e, i) for i, _ in reversed(path) if e.is_ok[i]),
             None)
 
-    # the stuck configuration: pending = ops still open under the WGL
-    # rule at the deepest config (invoked before every unlinearized
-    # return)
-    rets = np.asarray(e.return_idx, np.int64)
-    invoke = np.asarray(e.invoke_idx, np.int64)
-    un = ~linearized
-    rmin = rets[un].min() if un.any() else INF_TIME
-    pending = np.flatnonzero(un & (invoke < rmin))
-    result["configs"] = [{
-        "model": _decode_state(spec, best_state),
-        "last_op": (_decode_op(e, path[-1][0])
-                    if path else None),
-        "pending": [_decode_op(e, int(i)) for i in pending[:16]],
-    }]
+    result["configs"] = [config_entry(
+        spec, e, linearized, best_state,
+        last_op=_decode_op(e, path[-1][0]) if path else None)]
+    return result
+
+
+def attach_multi(result, spec, e, slots, init_state):
+    """Multi-config variant of ``attach``: ``slots`` is a list of
+    (linearized bool[n], state) deepest-first. The primary witness
+    fields (op / final_paths / previous_ok) decode from slot 0; EVERY
+    slot contributes a stuck-config entry with its own pending set
+    (knossos returns up to 10 :configs, reference checker.clj:213-216;
+    round 3 only ever produced one)."""
+    if not slots:
+        return result
+    linearized, state = slots[0]
+    attach(result, spec, e, linearized, state, init_state)
+    result["configs"] = result["configs"] + [
+        config_entry(spec, e, lin_s, st_s) for lin_s, st_s in slots[1:]]
     return result

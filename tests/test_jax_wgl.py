@@ -149,6 +149,35 @@ def test_differential_random(spec_name):
             "\n".join(str(o) for o in hist))
 
 
+def test_topk_witness_configs():
+    """An invalid history searched by the raw engine reports MULTIPLE
+    distinct stuck configs (knossos returns up to 10 :configs, reference
+    checker.clj:213-216; round 3 tracked exactly one deepest config, so
+    the downstream configs[:10] truncation could never fire)."""
+    import dataclasses
+    rng = random.Random(0)
+    hist = _corrupt(rng, _random_history(rng, "cas-register", n_procs=6,
+                                         n_ops=40, crash_p=0.05))
+    spec = models.cas_register_spec
+    e, st = spec.encode(hist)
+    # this seed's history must reach the search (not the fast paths)
+    assert jax_wgl._state_abstraction_check(spec, e, st) is None
+    forced = dataclasses.replace(spec, fast_check=None)
+    r = jax_wgl.check_encoded(forced, e, st)
+    assert r["valid"] is False
+    configs = r["configs"]
+    assert len(configs) >= 2
+    for c in configs:
+        assert "model" in c and "pending" in c
+    # the slots hold DISTINCT configurations
+    keys = {(str(c["model"]), str(c["pending"])) for c in configs}
+    assert len(keys) >= 2
+    # the oracle agrees on the verdict and also reports several configs
+    expect = wgl.check_encoded(spec, e, st)
+    assert expect["valid"] is False
+    assert len(expect.get("configs", [])) >= 2
+
+
 def test_differential_larger_register():
     rng = random.Random(7)
     spec = models.cas_register_spec

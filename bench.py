@@ -1,10 +1,14 @@
 """Benchmark: the BASELINE.json config ladder for the device WGL engine.
 
 Rungs (BASELINE.md north-star table):
-  0. max single-key history length decidable in 60 s (primary metric)
+  0. max single-key history length decidable in 60 s (primary metric),
+     measured to the engine's limit by exponential growth + bisection,
+     per model, including a raw-search FIFO row (round-4 rework)
   1. single ~200-op cas-register histories     (CPU-parity baseline)
   2. 32-key batched per-key checks, one chip   (jepsen.independent style)
-  2b. 256-key batch -- the throughput HEADLINE since round 3
+  2b. 256-key batch -- the throughput headline since round 3
+  2c. 1024-key batch + the keys-vs-throughput curve (headline is the
+      best of 2b/2c)
   3. mutex, high contention
   4/4b. FIFO queue, info-free (aspect fast path)
   4c. 10k-op FIFO with info dequeues (exact aspect, round-3 extension)
@@ -185,6 +189,36 @@ def main():
                             if r["valid"] == "unknown"),
     }
 
+    # rung 2c: K=1024 and the keys-vs-throughput CURVE (VERDICT r3 next
+    # #7: the claimed "throughput via the key axis" trade reported as a
+    # measured curve, not a point). Same per-key workload distribution
+    # as 2/2b; the 32- and 256-key points reuse those rungs' runs.
+    hists2c = list(hists2b)
+    for k in range(len(hists2c), 1024):
+        h = random_history(rng2, "cas-register", n_procs=8,
+                           n_ops=ops_per_key, crash_p=0.02)
+        if k % 8 == 7:
+            h = corrupt(rng2, h)
+        hists2c.append(h)
+    pairs2c = [spec.encode(h) for h in hists2c]
+    total2c = sum(len(e) for e, _ in pairs2c)
+    check_batch_encoded(spec, pairs2c)        # compile warmup
+    t0 = time.monotonic()
+    res2c = check_batch_encoded(spec, pairs2c)
+    dev2c_s = time.monotonic() - t0
+    rate2c = total2c / dev2c_s
+    rungs["2c-cas-1024key"] = {
+        "keys": 1024, "total_ops": total2c,
+        "device_s": round(dev2c_s, 3),
+        "device_rate": round(rate2c, 1),
+        "invalid_keys": sum(1 for r in res2c if r["valid"] is False),
+        "unknown_keys": sum(1 for r in res2c
+                            if r["valid"] == "unknown"),
+        "curve_ops_per_s": {"32": round(dev_rate, 1),
+                            "256": round(rate2b, 1),
+                            "1024": round(rate2c, 1)},
+    }
+
     # -- rung 3: mutex, high contention ----------------------------------
     e3, st3 = mutex_spec.encode(hist3)
     jax_wgl.check_encoded(mutex_spec, e3, st3, timeout_s=120)  # warm
@@ -280,32 +314,107 @@ def main():
     }
 
     # -- rung 0: the BASELINE primary metric -----------------------------
-    # max single-key history length decidable in 60 s (exponential
-    # ladder; the largest size whose check finishes inside the budget).
-    # chunk_iters is small so the wall-clock budget is enforced tightly.
+    # max single-key history length decidable in 60 s, measured to the
+    # engine's ACTUAL limit: exponential growth until a size fails the
+    # budget, then bisection to tighten the decided/undecided bracket.
+    # (Round 3 walked a hardcoded ladder whose top rung decided in
+    # 7.6 s, so the reported "max" was the ladder's end, not the
+    # engine's limit -- VERDICT r3 weak #1.) Each shape bucket is
+    # compile-warmed with a 1-iteration probe before its first timed
+    # run so growth gates on search time, not compile stalls;
+    # chunk_iters is small so the wall budget is enforced tightly.
+    import dataclasses
+    fifo_search = dataclasses.replace(fifo_queue_spec, fast_check=None)
+    BUDGET_S = 60.0
+    ROW_WALL_S = 480.0   # per-row cap on total probe time
+    rows0 = (
+        # (row key, model name, spec, procs, crash_p, start, cap)
+        ("cas-register", "cas-register", cas_register_spec, 64, 0.05,
+         16_000, 1_024_000),
+        ("mutex", "mutex", mutex_spec, 64, 0.05, 8_000, 1_024_000),
+        ("fifo-queue-aspect", "fifo-queue", fifo_queue_spec, 64, 0.05,
+         200_000, 1_600_000),
+        # the raw SEARCH engine on info-dequeue-bearing FIFO histories
+        # (aspect disabled, like rung 4d): the honest search-path row
+        ("fifo-queue-search", "fifo-queue", fifo_search, 16, 0.05,
+         2_000, 256_000),
+    )
     maxlen = {}
-    for mi, (mname, mspec, msizes) in enumerate((
-            ("cas-register", cas_register_spec, (8000, 16000, 32000)),
-            ("mutex", mutex_spec, (8000, 16000)),
-            ("fifo-queue", fifo_queue_spec, (200_000,)))):
-        # one independent stream per model: adding/removing a ladder row
-        # must never shift another model's histories across rounds
-        mrng = random.Random(77000 + mi)
-        best = None
-        for n_ops in msizes:
-            h = random_history(mrng, mname, n_procs=64, n_ops=n_ops,
-                               crash_p=0.05)
-            e0, st0 = mspec.encode(h)
-            t0 = time.monotonic()
-            r0 = jax_wgl.check_encoded(mspec, e0, st0, timeout_s=60,
-                                       chunk_iters=32)
-            dt0 = time.monotonic() - t0
-            if r0["valid"] in (True, False) and dt0 <= 60:
-                best = {"ops": len(e0), "s": round(dt0, 1),
-                        "engine": r0.get("engine", "jax-wgl")}
+    for mi, (row, mname, mspec, procs, crash_p, start, cap) in \
+            enumerate(rows0):
+
+        def attempt(n_ops, _mi=mi, _mname=mname, _mspec=mspec,
+                    _procs=procs, _crash=crash_p):
+            # one deterministic sub-seed per (row, size): growth and
+            # bisection probes never shift each other's histories, and
+            # rows stay independent across rounds
+            seed = 77000 + _mi * 1_000_003 + n_ops
+            h0 = random_history(random.Random(seed), _mname,
+                                n_procs=_procs, n_ops=n_ops,
+                                crash_p=_crash)
+            e0, st0 = _mspec.encode(h0)
+            try:
+                # 1-iteration probe: compiles the bucket's kernels
+                jax_wgl.check_encoded(_mspec, e0, st0, max_configs=1)
+                t0 = time.monotonic()
+                r0 = jax_wgl.check_encoded(_mspec, e0, st0,
+                                           timeout_s=BUDGET_S,
+                                           chunk_iters=32)
+                dt0 = time.monotonic() - t0
+            except Exception as exc:  # noqa: BLE001 - e.g. device OOM
+                return {"n_ops": n_ops, "ops": len(e0), "s": None,
+                        "ok": False, "error": repr(exc)[:200]}
+            return {"n_ops": n_ops, "ops": len(e0),
+                    "s": round(dt0, 1),
+                    "ok": bool(r0["valid"] in (True, False)
+                               and dt0 <= BUDGET_S),
+                    "engine": r0.get("engine", "jax-wgl"),
+                    "error": r0.get("error")}
+
+        t_row = time.monotonic()
+        good, bad = None, None
+        n = start
+        while n <= cap and time.monotonic() - t_row < ROW_WALL_S:
+            a = attempt(n)
+            if a["ok"]:
+                good, n = a, n * 2
             else:
+                bad = a
                 break
-        maxlen[mname] = best
+        # bisect the [good, bad] bracket until it's tight (<15%); the
+        # bracket>2000 guard keeps mid strictly inside the bracket at
+        # the 1000-op probe granularity (otherwise the clamp can pin
+        # mid to good's own size and the loop would spin re-running
+        # the identical probe until the row wall)
+        while (good is not None and bad is not None
+               and bad["n_ops"] - good["n_ops"] > 2000
+               and bad["n_ops"] > good["n_ops"] * 1.15
+               and time.monotonic() - t_row < ROW_WALL_S):
+            mid = round((good["n_ops"] + bad["n_ops"]) / 2, -3)
+            mid = int(min(max(mid, good["n_ops"] + 1000),
+                          bad["n_ops"] - 1000))
+            a = attempt(mid)
+            if a["ok"]:
+                good = a
+            else:
+                bad = a
+        entry = None
+        if good is not None:
+            entry = {"ops": good["ops"], "requested": good["n_ops"],
+                     "s": good["s"], "engine": good["engine"]}
+            if bad is not None:
+                entry["first_fail"] = {
+                    "requested": bad["n_ops"], "ops": bad["ops"],
+                    "s": bad["s"], "error": bad["error"]}
+            elif good["n_ops"] * 2 > cap:
+                entry["cap_reached"] = cap
+            else:
+                entry["row_budget_exhausted"] = True
+        elif bad is not None:
+            entry = {"ops": 0, "first_fail": {
+                "requested": bad["n_ops"], "ops": bad["ops"],
+                "s": bad["s"], "error": bad["error"]}}
+        maxlen[row] = entry
     rungs["0-maxlen-60s"] = maxlen
 
     # CPU oracles race in parallel subprocesses AFTER all device
@@ -334,11 +443,15 @@ def main():
                           "error": f"verdict mismatch: {agree}/{n_keys}"}))
         return
 
+    headline_rung, headline = max(
+        (("2b-cas-256key", rate2b), ("2c-cas-1024key", rate2c)),
+        key=lambda kv: kv[1])
     print(json.dumps({
         "metric": "ops verified/sec (cas-register)",
-        "value": round(rate2b, 1),
+        "value": round(headline, 1),
         "unit": "ops/s",
-        "vs_baseline": round(rate2b / cpu_rate, 3),
+        "vs_baseline": round(headline / cpu_rate, 3),
+        "headline_rung": headline_rung,
         "detail": rungs,
     }))
 

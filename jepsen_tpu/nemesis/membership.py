@@ -54,7 +54,13 @@ class State:
         raise NotImplementedError
 
     def invoke(self, test, op):
-        """Apply a generated op; returns the completed op."""
+        """Apply a generated op; returns the completed op.
+
+        Called with the nemesis lock HELD (so the view read, the
+        cluster operation, and the pending-set record are atomic with
+        respect to poller swaps): implementations must not block
+        indefinitely -- node-view polling stalls for the duration. The
+        lock is reentrant, so calling back into the nemesis is safe."""
         raise NotImplementedError
 
     def resolve(self, test):
@@ -118,7 +124,12 @@ class Nemesis(NemesisProto):
         self._running = threading.Event()
         self._stop = threading.Event()
         self._threads = []
-        self._lock = threading.Lock()
+        # RLock: State.invoke implementations may call back into this
+        # nemesis (e.g. via _swap) without deadlocking themselves
+        # (advisor finding r3). NOTE the lock is still held for the
+        # whole duration of State.invoke -- pollers wait it out -- so
+        # invoke implementations must not block indefinitely.
+        self._lock = threading.RLock()
 
     def _swap(self, f):
         with self._lock:
@@ -174,14 +185,14 @@ class Nemesis(NemesisProto):
     def invoke(self, test, op):
         # read + invoke + record under one lock hold: a poller swap
         # between the read and the pending-set update would make the
-        # invoke run against a stale view (the lock is not reentrant, so
-        # this inlines _swap rather than calling it)
+        # invoke run against a stale view (the lock is reentrant, so
+        # the nested _swap is fine)
         with self._lock:
             done = self.box["state"].invoke(test, op)
-            s = self.box["state"]
-            self.box["state"] = resolve(
-                s.assoc(pending=s.pending | {(_freeze(op), _freeze(done))}),
-                test, self.opts)
+            self._swap(lambda s: resolve(
+                s.assoc(pending=s.pending
+                        | {(_freeze(op), _freeze(done))}),
+                test, self.opts))
         return done
 
     def teardown(self, test):

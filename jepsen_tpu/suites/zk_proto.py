@@ -22,10 +22,12 @@ Format (big-endian), reconstructed from the public jute definitions
 
 ``FakeZkServer`` implements the same protocol server-side over a plain
 dict -- enough for the integration rig to drive the client through real
-sockets (tests/test_suite_zookeeper.py). The encoder/decoder pair being
-exercised against itself means the BYTE layout is only as good as this
-reconstruction; against a real ensemble any mismatch fails loudly at
-the connect handshake rather than silently corrupting values.
+sockets (tests/test_suite_zookeeper.py). The byte layout is pinned by
+hand-assembled golden frames derived from the public jute definitions
+(tests/test_wire_golden.py), so encode and decode are validated against
+fixtures this module did not produce -- not merely against each other;
+against a real ensemble any residual mismatch fails loudly at the
+connect handshake rather than silently corrupting values.
 """
 
 from __future__ import annotations

@@ -189,13 +189,27 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
         # iteration; lowering it to 64 cut rung 2 device time ~3x.)
         R = 0 if n <= 64 else min(256, n)
     if NS is None:
-        # Greedy chains rolled per iteration. The scan chain is LATENCY-
-        # bound (PROFILE.md rung 5: 67 us/micro-step on O(n) values), so
-        # widening each micro-step to NS seeds is nearly free while
-        # multiplying depth progress wherever the single chain wedges on
-        # a plateau (round 3 rolled exactly one DFS-top seed; VERDICT r3
-        # weak #2). Seeds are the top-NS children in DFS order.
-        NS = 8 if R else 1
+        # Greedy chains rolled per iteration, for SINGLE-KEY searches
+        # only. On the latency-bound single-key chain (PROFILE.md rung
+        # 5: 67 us/micro-step on O(n) values) widening each micro-step
+        # to NS seeds is nearly free and multiplies depth progress
+        # wherever one chain wedges on a plateau: measured 2.7x on a
+        # 58.8k-op (112k requested) mutex, 27.7 s -> 10.3 s at NS=8.
+        # On the key batch the O(K*NS*n) step work is no longer
+        # latency-shadowed and NS=8 measured ~1.4x SLOWER (256-key
+        # rung: 4.7 s -> 6.7 s), so the batch path pins NS=1
+        # explicitly (keyshard.py) -- this K==1 default only governs
+        # genuine single-key searches. Capped so the (NS, n, S)
+        # rollout tensor stays ~<=256 MB: big queue states otherwise
+        # build multi-GB intermediates that crash the TPU worker
+        # (observed on a 9k-op FIFO search).
+        NS = max(1, min(8, (64 << 20) // max(1, n * S))) if K == 1 else 1
+    if R and K * NS * n * S > (256 << 20):
+        # even at the chosen NS the rollout's (K, NS, n, S) step tensor
+        # would exceed ~1 GB (huge padded states x many keys): drop the
+        # rollout rather than risk the worker -- the search still
+        # progresses one depth level per iteration
+        R, NS = 0, 1
     ML = M + NS * R
     KML = K * ML
     Tc = 1 << 16   # twin-claim scratch; fixed so carries are W-independent
@@ -621,8 +635,14 @@ def _plan_sizes(n, S, C, frontier_width=None, stack_size=None,
                 table_size=None):
     B = max(1, (n + 31) // 32)
     if frontier_width is None:
-        # aim for ~32k candidate expansions per iteration
-        frontier_width = max(32, min(4096, 32768 // max(1, C)))
+        # aim for ~32k candidate expansions per iteration, capped so
+        # the (W, C, S) model-step tensor stays ~<=256 MB -- large
+        # padded queue states at high point-concurrency otherwise
+        # build multi-GB intermediates that crash the TPU worker
+        # (observed on a 9k-op FIFO search: C=512, S=8192)
+        frontier_width = max(
+            8, min(4096, 32768 // max(1, C),
+                   (64 << 20) // max(1, C * S)))
     if stack_size is None:
         # ~128 MB of stack at most
         per = (B + S) * 4
@@ -630,7 +650,7 @@ def _plan_sizes(n, S, C, frontier_width=None, stack_size=None,
     if table_size is None:
         table_size = 1 << 20
     # slot indexing uses h & (T-1): every size must be a power of two
-    return (B, _bucket(frontier_width, 32), _bucket(stack_size, 1024),
+    return (B, _bucket(frontier_width, 8), _bucket(stack_size, 1024),
             _bucket(table_size, 1024))
 
 
@@ -776,7 +796,8 @@ def _priority_order(spec, e, inv32, ret32):
 def check_encoded(spec, e, init_state, max_configs=50_000_000,
                   frontier_width=None, stack_size=None, table_size=None,
                   confirm=False, timeout_s=None, chunk_iters=256,
-                  checkpoint=None, checkpoint_every_s=60.0, cancel=None):
+                  checkpoint=None, checkpoint_every_s=60.0, cancel=None,
+                  rollout_seeds=None):
     """Device WGL search over an EncodedHistory. Result dict mirrors
     wgl.check_encoded: {"valid": True|False|"unknown", "configs_explored",
     ...}, plus device budget diagnostics. ``timeout_s`` bounds wall clock
@@ -843,7 +864,7 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     max_iters = max(1, max_configs // W)
 
     init_carry, run_chunk = _build_search(spec.step, 1, n_pad, B, S, C, A,
-                                          W, O, T)
+                                          W, O, T, NS=rollout_seeds)
     consts = (jnp.asarray(inv32[None]), jnp.asarray(ret32[None]),
               jnp.asarray(fop[None]), jnp.asarray(args[None]),
               jnp.asarray(rets[None]), jnp.asarray(ok_words[None]),

@@ -20,8 +20,22 @@ consistent with the prefix seen so far:
 The config-set stays small on low-contention histories (each return
 usually extends every config by a handful of ops), which is exactly
 when this algorithm beats WGL — and why the reference's competition
-races both. The set is bounded (``max_configs`` per event); overflow
-returns unknown rather than ever mis-deciding.
+races both: on long, low-concurrency, crash-free histories this engine
+decides in seconds where the device search pays per-iteration W*n work
+(BENCH rung 6 races them and linear wins on its home turf). Crashed
+(info) ops are its weakness — they stay open forever, so every return's
+closure explores subsets of all open infos; the budgets below turn
+that blowup into "unknown" quickly instead of burning CPU.
+
+Two bounds, both knossos-spirited (memory AND time), either overflow
+returning unknown rather than ever mis-deciding:
+
+* ``max_configs`` bounds the per-event configuration SET (memory);
+* ``max_steps`` bounds TOTAL model steps across the sweep (round 3
+  bounded only per-event sets, so a history with many open infos could
+  grind for minutes inside one event while "budgeted" — advisor-class
+  bug found while benchmarking: 13.4M steps on a nominally 200k-config
+  run).
 """
 
 from __future__ import annotations
@@ -31,7 +45,8 @@ import numpy as np
 from ..history import INF_TIME
 
 
-def check_encoded(spec, e, init_state, max_configs=100_000, cancel=None):
+def check_encoded(spec, e, init_state, max_configs=100_000,
+                  max_steps=5_000_000, cancel=None):
     """JIT-linearization over an EncodedHistory. Returns
     {"valid": True|False|"unknown", "configs_explored", "engine",
     "op"/... witness fields on failure}."""
@@ -58,12 +73,14 @@ def check_encoded(spec, e, init_state, max_configs=100_000, cancel=None):
     open_ops: list[int] = []
     explored = 0
 
+    overflow = "max-configs-exceeded"
+
     def expand_until(target, configs):
         """Closure: linearize sequences of open ops until `target` is
         linearized in every surviving config; returns the set of
         configs with target linearized (deduped), or None on
-        overflow."""
-        nonlocal explored
+        overflow (``overflow`` names which budget tripped)."""
+        nonlocal explored, overflow
         done = set()
         frontier = set()
         seen = set(configs)
@@ -78,6 +95,9 @@ def check_encoded(spec, e, init_state, max_configs=100_000, cancel=None):
                         continue
                     st2, ok = step(st, f[j], args[j], rets[j], np)
                     explored += 1
+                    if explored > max_steps:
+                        overflow = "max-steps-exceeded"
+                        return None
                     if not ok:
                         continue
                     st2 = np.asarray(st2, np.int32)
@@ -93,6 +113,7 @@ def check_encoded(spec, e, init_state, max_configs=100_000, cancel=None):
                     else:
                         nxt.add(c2)
                     if len(seen) > max_configs:
+                        overflow = "max-configs-exceeded"
                         return None
             frontier = nxt
         return done
@@ -107,7 +128,7 @@ def check_encoded(spec, e, init_state, max_configs=100_000, cancel=None):
         # return of op i: every config must have i linearized by now
         got = expand_until(i, configs)
         if got is None:
-            return {"valid": "unknown", "error": "max-configs-exceeded",
+            return {"valid": "unknown", "error": overflow,
                     "configs_explored": explored, "engine": "linear"}
         open_ops.remove(i)
         if not got:

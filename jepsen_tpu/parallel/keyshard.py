@@ -92,7 +92,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
                         chunk_iters=256, timeout_s=None, mesh=None,
                         frontier_width=None, stack_size=None,
                         table_size=None, checkpoint=None,
-                        checkpoint_every_s=60.0):
+                        checkpoint_every_s=60.0, rollout_seeds=None):
     """Check many keys' histories at once.
 
     ``pairs`` is a list of (EncodedHistory, init_state). Returns a list of
@@ -161,9 +161,20 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     B, W, O, T = _plan_sizes(n_pad, S_pad, C, frontier_width, stack_size,
                              table_size)
     if frontier_width is None:
-        W = max(32, min(W, 4096 // _bucket(n_live, 1)))
+        # narrow per key as the batch grows, but never RAISE W above
+        # what _plan_sizes chose -- its (W, C, S) memory cap must
+        # survive (a max(32, ...) floor here once re-inflated a
+        # capped-for-big-states W and rebuilt the crash tensor)
+        W = min(W, max(32, 4096 // _bucket(n_live, 1)))
     O = max(4096, O // _bucket(min(n_live, 8), 1))
     max_iters = max(1, max_configs // (W * n_live))
+    if rollout_seeds is None:
+        # batches roll ONE greedy chain per key: the chip is already
+        # filled by the key axis and extra seeds measured ~1.4x pure
+        # overhead (PROFILE.md round 4). Pinned here explicitly so a
+        # batch compacted down to one key (or mesh shards of one key
+        # each) can't silently flip into the single-key NS=8 regime.
+        rollout_seeds = 1
 
     cols = [_pad_key(pairs[k][0], pairs[k][1], spec, n_pad, S_pad, A,
                      encs[k])
@@ -195,7 +206,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         """run_chunk for a (possibly compacted/resumed) batch width."""
         if mesh is None:
             _, rb = _build_search(spec.step, Kc, n_pad, B, S_pad, C, A,
-                                  Wc, O, T, G)
+                                  Wc, O, T, G, NS=rollout_seeds)
             return rb
         try:
             from jax import shard_map
@@ -205,7 +216,8 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         # the kernel run under shard_map sees LOCAL shapes: Kc/G keys
         # and one table group per device
         _, run_local = _build_search(spec.step, Kc // G, n_pad, B,
-                                     S_pad, C, A, Wc, O, T, 1)
+                                     S_pad, C, A, Wc, O, T, 1,
+                                     NS=rollout_seeds)
         return jax.jit(shard_map(
             run_local.__wrapped__, mesh=mesh,
             in_specs=(carry_specs,) + const_specs,
@@ -213,8 +225,12 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
             donate_argnums=(0,))
 
     def wide_W(Kc):
-        # budget lanes per DEVICE: each shard runs Kc // G keys
-        return max(W, min(2048, 4096 // max(1, Kc // G)))
+        # budget lanes per DEVICE (each shard runs Kc // G keys),
+        # honoring the same (W, C, S) ~256 MB step-tensor cap as
+        # _plan_sizes -- widening a compacted straggler with a big
+        # padded state would otherwise rebuild the crash tensor
+        return max(W, min(2048, 4096 // max(1, Kc // G),
+                          max(8, (64 << 20) // max(1, C * S_pad))))
 
     def consts_for(alive_rows):
         sel = [cols[j] if j >= 0 else _dummy_key(n_pad, S_pad, A)
@@ -256,7 +272,8 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
             carry = tuple(jnp.asarray(x) for x in carry_np)
     else:
         init_carry, run_chunk = _build_search(spec.step, K, n_pad, B,
-                                              S_pad, C, A, W, O, T, G)
+                                              S_pad, C, A, W, O, T, G,
+                                              NS=rollout_seeds)
         run_b = build_runner(K, W) if mesh is not None else run_chunk
         carry = init_carry(init_states)
         if mesh is not None:

@@ -97,10 +97,25 @@ def ensure_user(username):
 
 
 def grepkill(pattern, signal="KILL"):
-    """Kill processes matching a pattern (control/util.clj:286)."""
+    """Kill processes matching a pattern (control/util.clj:286).
+
+    ``ps axww`` (unlimited width), NOT ``ps aux``: when any inherited
+    fd looks like a terminal (pytest, CI shells), ps truncates each
+    line at the screen width, so patterns matching argv past ~80
+    columns -- e.g. a daemon's long scratch-dir path or its ``--port``
+    flag -- silently match nothing and the kill becomes a no-op
+    (observed live: leaked toystore daemons surviving every teardown
+    under pytest while the same pipeline killed them standalone).
+
+    ``pattern`` is an extended regex (grep -E), passed single-quoted
+    so it may contain spaces and alternations; it must not contain
+    single quotes."""
+    if "'" in pattern:   # not assert: must survive python -O
+        raise ValueError("grepkill pattern must be single-quote-free")
     return exec_star("bash", "-c",
-                     f"ps aux | grep {pattern} | grep -v grep "
-                     f"| awk '{{print $2}}' | xargs -r kill -{signal}")
+                     f"ps axww -o pid=,args= | grep -E -- '{pattern}' "
+                     f"| grep -v grep | awk '{{print $1}}' "
+                     f"| xargs -r kill -{signal}")
 
 
 def signal(process_name, sig):

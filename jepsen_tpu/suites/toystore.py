@@ -183,13 +183,22 @@ class ToystoreDB(jdb.DB, jdb.Process, jdb.Pause, jdb.Primary,
     protocol the combined nemesis packages drive (db.clj:11-41)."""
 
     def _marker(self, test, node):
-        # unique SPACE-FREE argv marker (grepkill interpolates the
-        # pattern into a bash pipeline unquoted): the deployed script's
-        # full path appears in this node's argv and nobody else's
+        # unique argv marker (grepkill takes a quoted extended regex):
+        # the deployed script's full path appears in this node's argv
+        # and nobody else's
         return f"{node_dir(test, node)}/toystore.py"
 
     def setup(self, test, node):
         from ..control import util as cu
+        # A predecessor run that died without teardown (crashed test
+        # worker, kill -9) can leak a daemon still bound to this node's
+        # port, serving stale state -- every later run's daemon then
+        # fails to bind and reads hit the zombie, failing
+        # linearizability with phantom values. The teardown marker is
+        # path-specific on purpose (scratch dirs differ per run), so
+        # clear the PORT's owner here regardless of path.
+        cu.grepkill(
+            f"toystore[.]py --port {node_port(test, node)}([^0-9]|$)")
         d = node_dir(test, node)
         c.exec_("mkdir", "-p", d)
         c.upload_string(SERVER_SRC, f"{d}/toystore.py")

@@ -67,8 +67,11 @@ def test_toystore_end_to_end_with_kill_nemesis(tmp_path):
     logs = [p for p in (d / "n1").glob("*") if p.name == "toystore.log"] \
         if (d / "n1").exists() else []
     assert logs and "boot node=0" in logs[0].read_text()
-    # no server processes left behind
-    left = os.popen("ps aux | grep toystore.py | grep -v grep").read()
+    # no server processes left behind (axww: plain ps truncates argv
+    # at the terminal width and the scratch path sits past it, which
+    # would make this assertion pass vacuously)
+    left = os.popen(
+        "ps axww -o args= | grep toystore.py | grep -v grep").read()
     assert str(tmp_path) not in left
 
 
@@ -121,6 +124,52 @@ def test_daemon_helpers_against_live_process(tmp_path):
             assert cu.daemon_running(pidfile)
             cu.stop_daemon(pidfile=pidfile)
             assert not cu.daemon_running(pidfile)
+
+
+def test_toystore_setup_clears_zombie_daemons(tmp_path):
+    """A daemon leaked by a predecessor run that died without teardown
+    (crashed worker, kill -9) keeps its port bound and serves stale
+    state; every later run's reads would hit the zombie and fail
+    linearizability with phantom values. Setup must clear the port's
+    owner first (observed live: a pthread-fatal pytest abort leaked 3
+    daemons that then failed every subsequent pause-nemesis run)."""
+    import socket as _socket
+    import subprocess
+    import sys
+    import time as _time
+
+    base = 37170
+    zdir = tmp_path / "zombie"
+    zdir.mkdir()
+    (zdir / "toystore.py").write_text(toystore.SERVER_SRC)
+    # the zombie binds node n1's port with NO peers (its own primary)
+    # and gets fed a phantom value a fresh test could never explain
+    z = subprocess.Popen(
+        [sys.executable, str(zdir / "toystore.py"), "--port", str(base),
+         "--node-id", "0", "--peers", "", "--data-dir", str(zdir)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        for _ in range(50):
+            try:
+                with _socket.create_connection(("127.0.0.1", base), 1) as s:
+                    s.sendall(b"W x 9\n")
+                    assert s.makefile().readline().strip() == "OK"
+                break
+            except OSError:
+                _time.sleep(0.1)
+        else:
+            pytest.fail("zombie never came up")
+        test = toystore.toystore_test(_opts(tmp_path, base))
+        test = core.run(test)
+        assert test["results"]["valid"] is True, test["results"]
+        ps = subprocess.run(
+            ["bash", "-c", "ps aux | grep toystor[e]"],
+            capture_output=True, text=True).stdout
+        assert z.poll() is not None, \
+            f"setup must have killed zombie pid {z.pid}; ps:\n{ps}"
+    finally:
+        if z.poll() is None:
+            z.kill()
 
 
 @pytest.mark.parametrize("mode", ["pause"])

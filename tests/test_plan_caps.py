@@ -1,0 +1,76 @@
+"""Host-side plan/heuristic math for the device search: the memory
+caps that keep big-state searches from building multi-GB step tensors
+(a 9k-op FIFO probe crashed the TPU worker in the first BENCH_r04 run;
+see PROFILE.md round 4)."""
+
+import numpy as np
+
+from jepsen_tpu.checker import jax_wgl
+
+
+def test_plan_sizes_caps_step_tensor_for_big_states():
+    # the crash shape: C=512 (bucketed), S=8192 padded queue state
+    B, W, O, T = jax_wgl._plan_sizes(16384, 8192, 512)
+    # W*C*S bounded ~<=2x the 64M-element target (W buckets up to a
+    # power of two, at most doubling past the cap)
+    assert W * 512 * 8192 <= 2 * (64 << 20)
+    assert W >= 8
+
+    # small states keep the old throughput-oriented plan
+    B2, W2, O2, T2 = jax_wgl._plan_sizes(16384, 8, 64)
+    assert W2 == 512                       # 32768 // 64, unchanged
+
+
+def test_plan_sizes_explicit_width_honored():
+    _, W, _, _ = jax_wgl._plan_sizes(1024, 8192, 512,
+                                     frontier_width=64)
+    assert W == 64
+
+
+def test_batch_narrowing_never_raises_capped_width(monkeypatch):
+    """keyshard's per-key narrowing must not re-inflate a W that
+    _plan_sizes capped for big states (the max(32, ...) floor once
+    rebuilt the crash tensor)."""
+    import random
+
+    from jepsen_tpu.models import cas_register_spec
+    from jepsen_tpu.parallel import keyshard
+    from jepsen_tpu.simulate import random_history
+
+    seen = {}
+    orig = keyshard._build_search
+
+    def spy(step, K, n, B, S, C, A, W, O, T, G=1, R=None, NS=None):
+        seen.setdefault("calls", []).append(
+            {"K": K, "W": W, "NS": NS, "C": C, "S": S})
+        return orig(step, K, n, B, S, C, A, W, O, T, G, R, NS)
+
+    monkeypatch.setattr(keyshard, "_build_search", spy)
+    rng = random.Random(1)
+    pairs = [cas_register_spec.encode(
+        random_history(rng, "cas-register", 4, 30, 0.05))
+        for _ in range(3)]
+    keyshard.check_batch_encoded(cas_register_spec, pairs)
+    assert seen["calls"], "batch path never built a kernel"
+    for call in seen["calls"]:
+        # the batch path pins one rollout chain per key explicitly --
+        # even a compacted K=1 kernel must not flip to the NS=8 regime
+        assert call["NS"] == 1
+        # and the step tensor respects the ~2x-bucketed cap
+        assert call["W"] * call["C"] * call["S"] <= 2 * (64 << 20)
+
+
+def test_rollout_disabled_when_even_one_chain_is_too_big():
+    """K*NS*n*S past ~256M elements drops the rollout instead of
+    building the tensor (survive > decide-fast)."""
+    import jax.numpy as jnp
+
+    def step(st, f, a, r, xp):
+        return st, xp.asarray(True)
+
+    # n=16384, S=32768: n*S = 512M elements > 256M gate
+    init_carry, run_chunk = jax_wgl._build_search(
+        step, 1, 16384, 512, 32768, 4, 1, 8, 1024, 1024)
+    # the kernel builds (gate ran at trace level); a smoke init works
+    carry = init_carry(jnp.zeros((1, 32768), jnp.int32))
+    assert int(carry[jax_wgl.IDX_TOP][0]) == 1

@@ -639,9 +639,15 @@ def _plan_sizes(n, S, C, frontier_width=None, stack_size=None,
         # the (W, C, S) model-step tensor stays ~<=256 MB -- large
         # padded queue states at high point-concurrency otherwise
         # build multi-GB intermediates that crash the TPU worker
-        # (observed on a 9k-op FIFO search: C=512, S=8192)
+        # (observed on a 9k-op FIFO search: C=512, S=8192) -- AND at
+        # 16*C: width beyond the candidate branching buys nothing
+        # (measured on a 37k-op 2-process history: identical iteration
+        # counts at W=64/256/1024, wall 6.4 s / 15.8 s / 52.3 s --
+        # every extra lane is pure cost at low point-concurrency;
+        # exhaustion proofs trade the wider pop for more, cheaper
+        # iterations)
         frontier_width = max(
-            8, min(4096, 32768 // max(1, C),
+            8, min(4096, 32768 // max(1, C), 16 * C,
                    (64 << 20) // max(1, C * S)))
     if stack_size is None:
         # ~128 MB of stack at most
@@ -862,6 +868,15 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     # iteration, not 64 -- the checkpoint tests rely on it); the default
     # 50M-config budget keeps max_iters far above any real search
     max_iters = max(1, max_configs // W)
+    # scale the dispatch quantum down with history size: wall-clock and
+    # cancel budgets are only enforced BETWEEN chunks, and at 100k+ ops
+    # a 32-iteration chunk (each with a 256-step rollout scan over n
+    # lanes) can run minutes past timeout_s (BENCH_r04: a 96k-request
+    # probe overshot its 60 s budget to 282 s). Only ever SHRINKS the
+    # requested value (floor 1): explicit tiny chunk_iters are a
+    # documented cadence contract the checkpoint tests rely on
+    chunk_iters = max(1, min(chunk_iters,
+                             chunk_iters * 16384 // n_pad))
 
     init_carry, run_chunk = _build_search(spec.step, 1, n_pad, B, S, C, A,
                                           W, O, T, NS=rollout_seeds)

@@ -309,8 +309,14 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         # inside ONE dispatch and compaction never fires (measured at
         # K=256: a single 256-iteration chunk ate 23 s, with 25
         # exhaustion-proof stragglers dragging 231 finished keys'
-        # lanes the whole way)
+        # lanes the whole way) -- and with history SIZE, or timeout_s /
+        # checkpoint cadence (enforced only between dispatches) can
+        # overshoot by minutes on 100k-op keys, like the single-key
+        # path's 282 s overshoot (check_encoded's chunk scaling).
+        # Only ever shrinks the requested value (floor 1).
         eff_chunk = max(4, chunk_iters * 8 // max(16, len(alive)))
+        eff_chunk = max(1, min(chunk_iters, eff_chunk,
+                               chunk_iters * 16384 // n_pad))
         bound = min(it + eff_chunk, max_iters)
         t_chunk = _time.monotonic()
         carry = run_b(carry, *consts, jnp.int32(bound))

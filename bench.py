@@ -333,7 +333,7 @@ def main():
     jax_wgl.check_encoded(cas_register_spec, e6, st6, max_configs=1)
     t0 = time.monotonic()
     r6d = jax_wgl.check_encoded(cas_register_spec, e6, st6,
-                                timeout_s=90, chunk_iters=32)
+                                timeout_s=90)
     d6d = time.monotonic() - t0
     rungs["6-linear-home-turf"] = {
         "ops": len(e6), "procs": 2, "crash_p": 0.0,
@@ -352,8 +352,8 @@ def main():
     # 7.6 s, so the reported "max" was the ladder's end, not the
     # engine's limit -- VERDICT r3 weak #1.) Each shape bucket is
     # compile-warmed with a 1-iteration probe before its first timed
-    # run so growth gates on search time, not compile stalls;
-    # chunk_iters is small so the wall budget is enforced tightly.
+    # run so growth gates on search time, not compile stalls; the
+    # engine's adaptive dispatch quantum enforces the wall budget.
     import dataclasses
     fifo_search = dataclasses.replace(fifo_queue_spec, fast_check=None)
     BUDGET_S = 60.0
@@ -389,8 +389,7 @@ def main():
                 jax_wgl.check_encoded(_mspec, e0, st0, max_configs=1)
                 t0 = time.monotonic()
                 r0 = jax_wgl.check_encoded(_mspec, e0, st0,
-                                           timeout_s=BUDGET_S,
-                                           chunk_iters=32)
+                                           timeout_s=BUDGET_S)
                 dt0 = time.monotonic() - t0
             except Exception as exc:  # noqa: BLE001 - e.g. device OOM
                 return {"n_ops": n_ops, "ops": len(e0), "s": None,

@@ -124,19 +124,22 @@ RUNNING, VALID = np.int32(0), np.int32(1)
 
 #: carry tuple element indices with a per-key leading axis (the rest are
 #: shared per table-group); the batch checker's compaction gathers these
-KEYED = (0, 1, 2, 4, 5, 6, 7, 8, 9, 10)
+KEYED = (0, 1, 2, 3, 5, 6, 7, 8, 9, 10, 11)
 
 #: version tag hashed into checkpoint fingerprints: bump whenever the
 #: carry layout or table format changes, so snapshots from an older
 #: build are cleanly ignored instead of crashing the resume
-CARRY_LAYOUT = f"carry-v4:tab-interleaved,probes{PROBES},topk{TOPK}"
+CARRY_LAYOUT = f"carry-v5:tab-interleaved,probes{PROBES},topk{TOPK},incfp"
 
-#: carry tuple indices (v4 layout; single source of truth for every
+#: carry tuple indices (v5 layout; single source of truth for every
 #: consumer -- hardcoded copies desynchronized once already when v2's
-#: split tables were merged)
-(IDX_BUF_LIN, IDX_BUF_STATE, IDX_TOP, IDX_TAB, IDX_DROPPED, IDX_STATUS,
- IDX_EXPLORED, IDX_BEST_DEPTH, IDX_BEST_LIN, IDX_BEST_STATE, IDX_ITS,
- IDX_IT, IDX_CLAIM) = range(13)
+#: split tables were merged). v5 adds buf_fp: per-config PARTIAL HASH
+#: SUMS over the lin bitset, updated O(1) per child instead of re-
+#: hashing all B words per lane per iteration (the profiled dominant
+#: cost at 100k+ ops -- see PROFILE.md round 4)
+(IDX_BUF_LIN, IDX_BUF_STATE, IDX_BUF_FP, IDX_TOP, IDX_TAB, IDX_DROPPED,
+ IDX_STATUS, IDX_EXPLORED, IDX_BEST_DEPTH, IDX_BEST_LIN, IDX_BEST_STATE,
+ IDX_ITS, IDX_IT, IDX_CLAIM) = range(14)
 
 
 @functools.lru_cache(maxsize=64)
@@ -155,8 +158,10 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
     serialize the scatters per key and copy the (K,T) tables every
     iteration, which dominated runtime.
 
-    Carry layout (see KEYED): buf_lin (K,O,B) u32, buf_state (K,O,S) i32,
-    top (K,) i32, tab (G,T,2) u32 shared (h1/h2 fingerprint pairs
+    Carry layout (see KEYED): buf_lin (K,O,B) u32, buf_state (K,O,S)
+    i32, buf_fp (K,O,2) u32 (per-config incremental fingerprint sums
+    over the lin bitset; v5), top (K,) i32, tab (G,T,2) u32 shared
+    (h1/h2 fingerprint pairs
     interleaved so one gather fetches both words -- the two separate
     tables cost a second 590k-row gather per iteration, the kernel's
     single biggest op), dropped (K,) bool, status (K,)
@@ -225,26 +230,54 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
         step_one, in_axes=(None, 0, 0, 0)), in_axes=(0, None, None, None)),
         in_axes=(0, 0, 0, 0))
 
-    def fingerprint(words):
-        """words: (KM, B+S+1) uint32 -> two (KM,) uint32 hashes.
+    k1j, k2j = jnp.asarray(k1), jnp.asarray(k2)
 
-        Each word is xored with a per-position random key and passed through
-        the bijective finalizer _before_ summing. A plain keyed linear sum
-        (sum of w*k mod 2^32) is catastrophically weak in the high bits --
-        configs differing only in bit 31 of two different words always
-        collide, since 2^31*(k_i - k_j) = 0 mod 2^32 for odd keys -- and
-        such sibling configs are extremely common in this search."""
-        h1 = _mix32(jnp.sum(_mix32(words ^ k1[None, :]), axis=1,
-                            dtype=jnp.uint32))
-        h2 = _mix32(jnp.sum(_mix32(words ^ k2[None, :]), axis=1,
-                            dtype=jnp.uint32))
+    # Fingerprints are mix(sum_i mix(word_i ^ key_i)) over the config's
+    # (lin bitset, state, salt) words. Each word is xored with a
+    # per-position random key and passed through the bijective
+    # finalizer _before_ summing -- a plain keyed linear sum (sum of
+    # w*k mod 2^32) is catastrophically weak in the high bits: configs
+    # differing only in bit 31 of two different words always collide,
+    # since 2^31*(k_i - k_j) = 0 mod 2^32 for odd keys, and such
+    # sibling configs are extremely common in this search.
+    #
+    # The LIN part of the inner sum is carried per config (buf_fp) and
+    # updated O(1) per child -- every child flips exactly one bitset
+    # word, and the sum is mod-2^32 linear, so the incremental value
+    # is bit-identical to a from-scratch hash. Re-hashing all B words
+    # for every lane was the profiled dominant per-iteration cost at
+    # 100k+ ops (PROFILE.md round 4: ~0.5-1 GB of hash work per
+    # iteration at n=262k). State words still hash fresh (they change
+    # wholesale each step; O(S) per lane).
+
+    def finalize_fp(sum1, sum2, st, saltv):
+        """Combine incremental lin-sums (leading shape L) with freshly
+        hashed state words st (L, S) and the per-key salt (L,) into
+        the table fingerprint pair."""
+        stw = st.astype(jnp.uint32)
+        s1 = sum1 + jnp.sum(_mix32(stw ^ k1j[B:B + S]), axis=-1,
+                            dtype=jnp.uint32) + _mix32(saltv
+                                                       ^ k1j[B + S])
+        s2 = sum2 + jnp.sum(_mix32(stw ^ k2j[B:B + S]), axis=-1,
+                            dtype=jnp.uint32) + _mix32(saltv
+                                                       ^ k2j[B + S])
+        h1 = _mix32(s1)
+        h2 = _mix32(s2)
         # reserve (0,0): the empty table slot
-        h2 = jnp.where((h1 == 0) & (h2 == 0), jnp.uint32(1), h2)
-        return h1, h2
+        return h1, jnp.where((h1 == 0) & (h2 == 0), jnp.uint32(1), h2)
+
+    def lin_deltas(oldw, neww, wsel):
+        """Sum deltas for flipping word index ``wsel`` (any shape) from
+        oldw to neww: mix(new^k_w) - mix(old^k_w), mod 2^32."""
+        kw1 = jnp.take(k1j, wsel)
+        kw2 = jnp.take(k2j, wsel)
+        return (_mix32(neww ^ kw1) - _mix32(oldw ^ kw1),
+                _mix32(neww ^ kw2) - _mix32(oldw ^ kw2))
 
     def body(carry, consts):
-        (buf_lin, buf_state, top, tabg, dropped, status, explored,
-         best_depth, best_lin, best_state, its, it, claimg) = carry
+        (buf_lin, buf_state, buf_fp, top, tabg, dropped, status,
+         explored, best_depth, best_lin, best_state, its, it,
+         claimg) = carry
         tab, claim = tabg[0], claimg[0]
         invoke, ret, fop, args, rets, ok_words, salt, bound = consts
         running = (status == RUNNING) & (top > 0)             # (K,)
@@ -265,6 +298,8 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
                        axis=0).reshape(K, W, B)
         state = jnp.take(buf_state.reshape(K * O, S), gidx,
                          axis=0).reshape(K, W, S)
+        fsum = jnp.take(buf_fp.reshape(K * O, 2), gidx,
+                        axis=0).reshape(K, W, 2)
         top = start
 
         # -- candidate selection (the WGL rule) -----------------------------
@@ -313,12 +348,20 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
         st2, okf = step_vvv(state, fc, ac, rc)            # (K,W,C,S),(K,W,C)
         st2 = st2.astype(jnp.int32)
 
+        wselc = jnp.take(word_idx, ci)                        # (K,W,C)
+        bitc = jnp.uint32(1) << jnp.take(bit_idx, ci)
         addmask = jnp.where(
             arange_B[None, None, None, :]
-            == jnp.take(word_idx, ci)[..., None].astype(jnp.uint32),
-            jnp.uint32(1) << jnp.take(bit_idx, ci)[..., None],
-            jnp.uint32(0))                                    # (K,W,C,B)
+            == wselc[..., None].astype(jnp.uint32),
+            bitc[..., None], jnp.uint32(0))                   # (K,W,C,B)
         lin2 = lin[:, :, None, :] | addmask
+        # incremental fingerprint sums: each child flips exactly one
+        # bitset word (oldw -> oldw|bit); one gather per lane replaces
+        # a full B-word re-hash
+        oldw = jnp.take_along_axis(lin, wselc, axis=2)        # (K,W,C)
+        d1, d2 = lin_deltas(oldw, oldw | bitc, wselc)
+        sum1c = fsum[..., 0][:, :, None] + d1                 # (K,W,C)
+        sum2c = fsum[..., 1][:, :, None] + d2
 
         child_valid = cvalid & okf & fvalid[..., None]
         okw = ok_words[:, None, None, :]
@@ -400,7 +443,10 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
                     + (C - 1 - arange_C)[None, :]).reshape(M)   # (M,)
         score = jnp.where(child_valid.reshape(K, M),
                           dfs_rank[None, :], -1)
+        sum1k = sum1c.reshape(K, M)
+        sum2k = sum2c.reshape(K, M)
         seed_lin_l, seed_st_l, seed_ok_l = [], [], []
+        seed_s1_l, seed_s2_l = [], []
         for _s in range(NS):
             smax = jnp.max(score, axis=1)                      # (K,)
             ok_s = running & (smax >= 0)
@@ -413,14 +459,20 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
             seed_st_l.append(jnp.sum(
                 jnp.where(spick[..., None], st2k, 0), axis=1,
                 dtype=jnp.int32))
+            seed_s1_l.append(jnp.sum(jnp.where(spick, sum1k, 0),
+                                     axis=1, dtype=jnp.uint32))
+            seed_s2_l.append(jnp.sum(jnp.where(spick, sum2k, 0),
+                                     axis=1, dtype=jnp.uint32))
             seed_ok_l.append(ok_s)
             score = jnp.where(spick, -1, score)
         seed_lin = jnp.stack(seed_lin_l, axis=1)               # (K,NS,B)
         seed_st = jnp.stack(seed_st_l, axis=1)                 # (K,NS,S)
         seed_ok = jnp.stack(seed_ok_l, axis=1)                 # (K,NS)
+        seed_s1 = jnp.stack(seed_s1_l, axis=1)                 # (K,NS)
+        seed_s2 = jnp.stack(seed_s2_l, axis=1)                 # (K,NS)
 
         def roll_step(rc_, _):
-            lin_r, st_r, alive = rc_                        # (K,NS,B) ...
+            lin_r, st_r, alive, s1_r, s2_r = rc_            # (K,NS,B) ...
             wb = jnp.repeat(lin_r, 32, axis=2)[:, :, :n]      # (K,NS,n)
             unl = ((wb >> bit_idx[None, None, :]) & jnp.uint32(1)) == 0
             rm = jnp.min(jnp.where(unl, ret[:, None, :], INF32),
@@ -433,23 +485,35 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
             j = jnp.argmax(succ, axis=2).astype(jnp.int32)    # (K,NS)
             took = succ.any(axis=2)
             wsel = jnp.take(word_idx, j)
+            bitj = jnp.uint32(1) << jnp.take(bit_idx, j)
             bmask = (arange_B[None, None, :]
                      == wsel[..., None].astype(jnp.uint32))
             newlin = lin_r | jnp.where(
-                bmask & took[..., None],
-                jnp.uint32(1) << jnp.take(bit_idx, j)[..., None],
+                bmask & took[..., None], bitj[..., None],
                 jnp.uint32(0))
             newst = jnp.where(
                 took[..., None],
                 jnp.take_along_axis(stn, j[..., None, None],
                                     axis=2)[:, :, 0]
                 .astype(jnp.int32), st_r)
+            oldw = jnp.take_along_axis(lin_r, wsel[..., None],
+                                       axis=2)[..., 0]        # (K,NS)
+            d1, d2 = lin_deltas(oldw, oldw | bitj, wsel)
+            s1_r = jnp.where(took, s1_r + d1, s1_r)
+            s2_r = jnp.where(took, s2_r + d2, s2_r)
             alive = alive & took
-            return (newlin, newst, alive), (newlin, newst, alive)
+            return ((newlin, newst, alive, s1_r, s2_r),
+                    (newlin, newst, alive, s1_r, s2_r))
 
         if R:
-            _, (ch_lin, ch_st, ch_alive) = lax.scan(
-                roll_step, (seed_lin, seed_st, seed_ok), None, length=R)
+            # unroll: the chain is LATENCY-bound (PROFILE.md: ~26 us
+            # busy vs ~175 us wall per micro-step at n=131k -- the gap
+            # is loop-boundary dispatch latency); unrolling fuses 8
+            # micro-steps per loop iteration so XLA schedules across
+            # step boundaries
+            _, (ch_lin, ch_st, ch_alive, ch_s1, ch_s2) = lax.scan(
+                roll_step, (seed_lin, seed_st, seed_ok, seed_s1,
+                            seed_s2), None, length=R, unroll=8)
             # (R,K,NS,*) -> (K,NS,R,*); flip the seed axis so the BEST
             # seed's chain flattens to the LAST lanes (= top of stack,
             # its deepest config on the very top), then fold seeds into
@@ -459,6 +523,10 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
             ch_st = jnp.transpose(ch_st, (1, 2, 0, 3))[:, ::-1] \
                 .reshape(K, NS * R, S)
             ch_alive = jnp.transpose(ch_alive, (1, 2, 0))[:, ::-1] \
+                .reshape(K, NS * R)
+            ch_s1 = jnp.transpose(ch_s1, (1, 2, 0))[:, ::-1] \
+                .reshape(K, NS * R)
+            ch_s2 = jnp.transpose(ch_s2, (1, 2, 0))[:, ::-1] \
                 .reshape(K, NS * R)
 
             okw2 = ok_words[:, None, :]
@@ -492,16 +560,19 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
             all_lin = jnp.concatenate([exp_lin, ch_lin], axis=1)
             all_st = jnp.concatenate([exp_st, ch_st], axis=1)
             all_val = jnp.concatenate([exp_val, ch_alive], axis=1)
+            all_s1 = jnp.concatenate([sum1k, ch_s1], axis=1)
+            all_s2 = jnp.concatenate([sum2k, ch_s2], axis=1)
         else:
             all_lin, all_st, all_val = exp_lin, exp_st, exp_val
+            all_s1, all_s2 = sum1k, sum2k
 
         # -- fingerprints (key-salted: all keys share the tables) -----------
         lin2f = all_lin.reshape(KML, B)
         st2f = all_st.reshape(KML, S)
+        sum1f = all_s1.reshape(KML)
+        sum2f = all_s2.reshape(KML)
         saltw = jnp.broadcast_to(salt[:, None], (K, ML)).reshape(KML)
-        words = jnp.concatenate(
-            [lin2f, st2f.astype(jnp.uint32), saltw[:, None]], axis=1)
-        h1, h2 = fingerprint(words)
+        h1, h2 = finalize_fp(sum1f, sum2f, st2f, saltw)
         cv = all_val.reshape(KML)
 
         # In-batch twin dedup: parents in the same frontier often generate
@@ -572,6 +643,9 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
             .set(lin2f, mode="drop").reshape(K, O, B)
         buf_state = buf_state.reshape(K * O, S).at[fpos] \
             .set(st2f, mode="drop").reshape(K, O, S)
+        buf_fp = buf_fp.reshape(K * O, 2).at[fpos] \
+            .set(jnp.stack([sum1f, sum2f], axis=-1),
+                 mode="drop").reshape(K, O, 2)
         # renormalize so the absolute counter can't overflow int32 over
         # long runs: shifting by O preserves every slot index mod O, and
         # `dropped` has already latched once a wrap occurred
@@ -583,7 +657,7 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
                                                    dtype=jnp.int32), 0)
         its = its + running.astype(jnp.int32)
         it = it + 1
-        return (buf_lin, buf_state, top, tab[None], dropped,
+        return (buf_lin, buf_state, buf_fp, top, tab[None], dropped,
                 status, explored, best_depth, best_lin, best_state, its,
                 it, claim[None])
 
@@ -591,7 +665,12 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
         buf_lin = jnp.zeros((K, O, B), jnp.uint32)
         buf_state = jnp.zeros((K, O, S), jnp.int32) \
             .at[:, 0, :].set(init_states)
-        return (buf_lin, buf_state, jnp.ones(K, jnp.int32),
+        # every slot starts with the all-zero bitset's lin-sums (only
+        # slot 0 is live; the rest are overwritten before any pop)
+        z = jnp.stack([jnp.sum(_mix32(k1j[:B]), dtype=jnp.uint32),
+                       jnp.sum(_mix32(k2j[:B]), dtype=jnp.uint32)])
+        buf_fp = jnp.broadcast_to(z, (K, O, 2)).astype(jnp.uint32)
+        return (buf_lin, buf_state, buf_fp, jnp.ones(K, jnp.int32),
                 jnp.zeros((G, T, 2), jnp.uint32),
                 jnp.zeros(K, bool), jnp.full(K, RUNNING),
                 jnp.zeros(K, jnp.int32),
@@ -868,15 +947,6 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     # iteration, not 64 -- the checkpoint tests rely on it); the default
     # 50M-config budget keeps max_iters far above any real search
     max_iters = max(1, max_configs // W)
-    # scale the dispatch quantum down with history size: wall-clock and
-    # cancel budgets are only enforced BETWEEN chunks, and at 100k+ ops
-    # a 32-iteration chunk (each with a 256-step rollout scan over n
-    # lanes) can run minutes past timeout_s (BENCH_r04: a 96k-request
-    # probe overshot its 60 s budget to 282 s). Only ever SHRINKS the
-    # requested value (floor 1): explicit tiny chunk_iters are a
-    # documented cadence contract the checkpoint tests rely on
-    chunk_iters = max(1, min(chunk_iters,
-                             chunk_iters * 16384 // n_pad))
 
     init_carry, run_chunk = _build_search(spec.step, 1, n_pad, B, S, C, A,
                                           W, O, T, NS=rollout_seeds)
@@ -911,8 +981,24 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     last_ckpt = t0
     timed_out = False
     it = int(carry[IDX_IT][0])
+    # Adaptive dispatch quantum. ``chunk_iters`` is the CAP (explicit
+    # tiny values are a cadence contract the checkpoint tests rely
+    # on); within it, the quantum is chosen from the measured
+    # per-iteration wall so each dispatch targets ~3 s and never
+    # overshoots the remaining budget by more than ~one misprediction.
+    # Both failure modes are measured: a fixed 32-iteration chunk
+    # overshot a 60 s budget to 282 s on a 96k-op history (budgets are
+    # only enforced BETWEEN dispatches), and a fixed-small chunk
+    # made the same history SYNC-bound -- hundreds of host round
+    # trips over the remote-TPU tunnel (BENCH_r04 / PROFILE.md).
+    # first dispatch: small enough to calibrate cheaply even at huge
+    # shapes (a 32-iteration first chunk at n_pad=262k ran 353 s
+    # before the first budget check); adaptation takes over after it
+    eff = min(chunk_iters, 32, max(1, (32 * 16384) // n_pad))
     while True:
-        bound = min(it + chunk_iters, max_iters)
+        prev_it = it
+        t_chunk = _time.monotonic()
+        bound = min(it + eff, max_iters)
         carry = run_chunk(carry, *consts, jnp.int32(bound))
         status, top, it = (int(carry[IDX_STATUS][0]),
                            int(carry[IDX_TOP][0]),
@@ -920,6 +1006,8 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
         if status != RUNNING or top == 0 or it >= max_iters:
             break
         now = _time.monotonic()
+        per_it = max(1e-4, (now - t_chunk) / max(1, it - prev_it))
+        eff = max(1, min(chunk_iters, int(3.0 / per_it)))
         if checkpoint is not None and \
                 now - last_ckpt >= checkpoint_every_s:
             _save_checkpoint(checkpoint, fingerprint, carry)
@@ -930,6 +1018,9 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
             if checkpoint is not None:
                 _save_checkpoint(checkpoint, fingerprint, carry)
             break
+        if timeout_s is not None:
+            left = timeout_s - (now - t0)
+            eff = max(1, min(eff, int(left / per_it) + 1))
 
     out = {"status": carry[IDX_STATUS][0], "top": carry[IDX_TOP][0],
            "dropped": carry[IDX_DROPPED][0],

@@ -80,7 +80,7 @@ def _dummy_key(n_pad, S_pad, A):
             None)
 
 
-def _shard_specs(mesh, n_carry=13, n_consts=8):
+def _shard_specs(mesh, n_carry=14, n_consts=8):
     from jax.sharding import PartitionSpec as P
     ax = mesh.axis_names[0]
     carry_specs = tuple(P(ax) for _ in range(n_carry))
@@ -288,6 +288,13 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     t0 = _time.monotonic()
     last_ckpt = t0
     timed_out = False
+    # adaptive dispatch quantum, like check_encoded's: calibrated from
+    # the measured per-iteration wall. The batch targets ~1 s per
+    # dispatch (shorter than the single-key 3 s: harvest/compaction
+    # polls between dispatches are load-bearing here), still capped by
+    # the live-width term below and by ``chunk_iters``.
+    eff_chunk = max(1, min(chunk_iters, 8, (8 * 16384) // n_pad))
+    per_it = None
 
     def harvest(rows, carry):
         fields = {"status": carry[IDX_STATUS], "top": carry[IDX_TOP],
@@ -304,24 +311,28 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
                                        for k, v in got.items()}
 
     while True:
-        # per-iteration cost scales with the live batch width, so chunk
-        # granularity must shrink as K grows or the whole run completes
-        # inside ONE dispatch and compaction never fires (measured at
-        # K=256: a single 256-iteration chunk ate 23 s, with 25
-        # exhaustion-proof stragglers dragging 231 finished keys'
-        # lanes the whole way) -- and with history SIZE, or timeout_s /
-        # checkpoint cadence (enforced only between dispatches) can
-        # overshoot by minutes on 100k-op keys, like the single-key
-        # path's 282 s overshoot (check_encoded's chunk scaling).
-        # Only ever shrinks the requested value (floor 1).
-        eff_chunk = max(4, chunk_iters * 8 // max(16, len(alive)))
-        eff_chunk = max(1, min(chunk_iters, eff_chunk,
-                               chunk_iters * 16384 // n_pad))
         bound = min(it + eff_chunk, max_iters)
         t_chunk = _time.monotonic()
+        prev_it = it
         carry = run_b(carry, *consts, jnp.int32(bound))
         it = bound
+        # the dispatch returns asynchronously: sync on the status read
+        # BEFORE measuring the chunk's wall time
         status = np.asarray(carry[IDX_STATUS])
+        now = _time.monotonic()
+        per_it = max(1e-4, (now - t_chunk) / max(1, it - prev_it))
+        # chunk granularity shrinks as the live batch width grows or
+        # the whole run completes inside ONE dispatch and compaction
+        # never fires (measured at K=256: a single 256-iteration chunk
+        # ate 23 s, with 25 exhaustion-proof stragglers dragging 231
+        # finished keys' lanes the whole way)
+        width_cap = max(4, chunk_iters * 8 // max(16, len(alive)))
+        eff_chunk = max(1, min(chunk_iters, width_cap,
+                               int(1.0 / per_it) + 1))
+        if timeout_s is not None:
+            left = timeout_s - (now - t0)
+            eff_chunk = max(1, min(eff_chunk,
+                                   int(left / per_it) + 1))
         if logger.isEnabledFor(logging.DEBUG):
             logger.debug(
                 "chunk to it=%d: %.3fs, K=%d running=%d", it,

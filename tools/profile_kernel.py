@@ -1,14 +1,16 @@
 """Capture and summarize a jax.profiler trace of the WGL search kernel.
 
-Reproduces the numbers in PROFILE.md: runs a rung-2-style multi-key batch
-(or rung-5 single key with --rung 5) under ``jax.profiler.trace``, then
-parses the TensorBoard trace JSON into a per-op device-time table with
-HLO source attribution (the trace events carry ``source`` args pointing
-at jax_wgl.py lines, which is how the round-3 bottlenecks were found).
+Reproduces the numbers in PROFILE.md: runs a rung-2-style multi-key
+batch, the rung-5 single key (--rung 5), or the rung-0 maxlen shape
+(--rung 0: large n, high point-concurrency -- the primary-metric
+workload) under ``jax.profiler.trace``, then parses the TensorBoard
+trace JSON into a per-op device-time table with HLO source attribution
+(the trace events carry ``source`` args pointing at jax_wgl.py lines,
+which is how the round-3 and round-4 bottlenecks were found).
 
 Usage::
 
-    python tools/profile_kernel.py [--rung 2|5] [--keys 256] [--out DIR]
+    python tools/profile_kernel.py [--rung 0|2|5] [--keys 256] [--out DIR]
 """
 
 from __future__ import annotations
@@ -45,13 +47,23 @@ def capture(out_dir, rung, keys):
         check_batch_encoded(spec, pairs)          # compile warmup
         with jax.profiler.trace(out_dir):
             check_batch_encoded(spec, pairs)
-    else:
+    elif rung == 5:
         hist = random_history(rng, "cas-register", n_procs=64,
                               n_ops=10_000, crash_p=0.05)
         e, st = spec.encode(hist)
         jax_wgl.check_encoded(spec, e, st)        # compile warmup
         with jax.profiler.trace(out_dir):
             jax_wgl.check_encoded(spec, e, st)
+    else:
+        # rung 0: the maxlen primary-metric shape (large n, high C)
+        hist = random_history(random.Random(77000 + 80000),
+                              "cas-register", n_procs=64, n_ops=80_000,
+                              crash_p=0.05)
+        e, st = spec.encode(hist)
+        jax_wgl.check_encoded(spec, e, st, max_configs=1)   # warmup
+        with jax.profiler.trace(out_dir):
+            jax_wgl.check_encoded(spec, e, st, timeout_s=120,
+                                  chunk_iters=32)
 
 
 def summarize(out_dir, top=15):
@@ -94,7 +106,7 @@ def summarize(out_dir, top=15):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rung", type=int, default=2, choices=(2, 5))
+    ap.add_argument("--rung", type=int, default=2, choices=(0, 2, 5))
     ap.add_argument("--keys", type=int, default=256)
     ap.add_argument("--out", default="/tmp/jepsen_tpu_profile")
     ap.add_argument("--parse-only", action="store_true")

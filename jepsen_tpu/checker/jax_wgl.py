@@ -710,6 +710,21 @@ def _bucket(x, lo):
     return max(lo, 1 << (int(x) - 1).bit_length())
 
 
+def _adapt_quantum(cap, per_it, target_s, left_s=None):
+    """Next dispatch quantum (shared by the single-key and batched
+    loops): ~``target_s`` of measured per-iteration wall, capped by the
+    caller's ``chunk_iters`` contract, and shrunk to fit the remaining
+    wall budget ``left_s`` (budgets are only enforced BETWEEN
+    dispatches, so a mispredicted quantum is the whole overshoot).
+    Both fixed policies failed measurably: large chunks overshot a
+    60 s budget to 282 s; fixed-small chunks made big searches
+    sync-bound over the remote-TPU tunnel (PROFILE.md round 4)."""
+    eff = max(1, min(cap, int(target_s / per_it)))
+    if left_s is not None:
+        eff = max(1, min(eff, int(left_s / per_it) + 1))
+    return eff
+
+
 def _plan_sizes(n, S, C, frontier_width=None, stack_size=None,
                 table_size=None):
     B = max(1, (n + 31) // 32)
@@ -1007,7 +1022,9 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
             break
         now = _time.monotonic()
         per_it = max(1e-4, (now - t_chunk) / max(1, it - prev_it))
-        eff = max(1, min(chunk_iters, int(3.0 / per_it)))
+        eff = _adapt_quantum(
+            chunk_iters, per_it, 3.0,
+            timeout_s - (now - t0) if timeout_s is not None else None)
         if checkpoint is not None and \
                 now - last_ckpt >= checkpoint_every_s:
             _save_checkpoint(checkpoint, fingerprint, carry)
@@ -1018,9 +1035,6 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
             if checkpoint is not None:
                 _save_checkpoint(checkpoint, fingerprint, carry)
             break
-        if timeout_s is not None:
-            left = timeout_s - (now - t0)
-            eff = max(1, min(eff, int(left / per_it) + 1))
 
     out = {"status": carry[IDX_STATUS][0], "top": carry[IDX_TOP][0],
            "dropped": carry[IDX_DROPPED][0],

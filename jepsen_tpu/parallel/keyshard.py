@@ -288,13 +288,13 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     t0 = _time.monotonic()
     last_ckpt = t0
     timed_out = False
-    # adaptive dispatch quantum, like check_encoded's: calibrated from
-    # the measured per-iteration wall. The batch targets ~1 s per
-    # dispatch (shorter than the single-key 3 s: harvest/compaction
-    # polls between dispatches are load-bearing here), still capped by
-    # the live-width term below and by ``chunk_iters``.
+    # adaptive dispatch quantum (jax_wgl._adapt_quantum, shared with
+    # the single-key loop): calibrated from the measured per-iteration
+    # wall. The batch targets ~1 s per dispatch (shorter than the
+    # single-key 3 s: harvest/compaction polls between dispatches are
+    # load-bearing here), still capped by the live-width term below
+    # and by ``chunk_iters``.
     eff_chunk = max(1, min(chunk_iters, 8, (8 * 16384) // n_pad))
-    per_it = None
 
     def harvest(rows, carry):
         fields = {"status": carry[IDX_STATUS], "top": carry[IDX_TOP],
@@ -327,12 +327,9 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         # ate 23 s, with 25 exhaustion-proof stragglers dragging 231
         # finished keys' lanes the whole way)
         width_cap = max(4, chunk_iters * 8 // max(16, len(alive)))
-        eff_chunk = max(1, min(chunk_iters, width_cap,
-                               int(1.0 / per_it) + 1))
-        if timeout_s is not None:
-            left = timeout_s - (now - t0)
-            eff_chunk = max(1, min(eff_chunk,
-                                   int(left / per_it) + 1))
+        eff_chunk = jax_wgl._adapt_quantum(
+            min(chunk_iters, width_cap), per_it, 1.0,
+            timeout_s - (now - t0) if timeout_s is not None else None)
         if logger.isEnabledFor(logging.DEBUG):
             logger.debug(
                 "chunk to it=%d: %.3fs, K=%d running=%d", it,

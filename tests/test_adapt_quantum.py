@@ -1,0 +1,35 @@
+"""The adaptive dispatch-quantum helper shared by both search loops
+(jax_wgl._adapt_quantum): budgets are only enforced between dispatches,
+so the quantum must target a fixed wall per dispatch and never
+overshoot the remaining budget by more than one misprediction."""
+
+from jepsen_tpu.checker.jax_wgl import _adapt_quantum
+
+
+def test_targets_wall_seconds():
+    # 10 ms per iteration, 3 s target -> 300 iterations
+    assert _adapt_quantum(1024, 0.010, 3.0) == 300
+
+
+def test_caller_cap_is_a_contract():
+    # explicit tiny chunk_iters (the checkpoint tests' cadence) wins
+    assert _adapt_quantum(1, 0.001, 3.0) == 1
+    assert _adapt_quantum(4, 1e-4, 3.0) == 4
+
+
+def test_slow_iterations_floor_at_one():
+    # slower than the target per iteration: still dispatch one
+    assert _adapt_quantum(256, 10.0, 3.0) == 1
+
+
+def test_budget_shrink():
+    # 0.5 s per iteration, 1.2 s left: 1.2/0.5 + 1 = 3 iterations max
+    assert _adapt_quantum(256, 0.5, 3.0, left_s=1.2) == 3
+    # budget exhausted: still one iteration (the loop's break decides)
+    assert _adapt_quantum(256, 0.5, 3.0, left_s=0.0) == 1
+    assert _adapt_quantum(256, 0.5, 3.0, left_s=-5.0) == 1
+
+
+def test_budget_shrink_never_raises_above_target():
+    # plenty of budget left: the wall target still governs
+    assert _adapt_quantum(1024, 0.010, 3.0, left_s=1000.0) == 300

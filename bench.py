@@ -409,10 +409,25 @@ def main():
                     "error": r0.get("error")}
 
         t_row = time.monotonic()
+
+        def attempt_fair(n_ops):
+            """One retry when a not-ok probe grossly overshot the
+            budget (>1.5x) -- whether it timed out or decided too
+            late: the adaptive quantum calibrates from measured
+            per-iteration wall, so a mid-probe tunnel hiccup can burn
+            the window without giving the search a fair 60 s; deciding
+            on retry proves 60 s decidability honestly. Skipped once
+            the row wall is spent (a retry would double the overrun)."""
+            a = attempt(n_ops)
+            if (not a["ok"] and a["s"] is not None
+                    and a["s"] > BUDGET_S * 1.5
+                    and time.monotonic() - t_row < ROW_WALL_S):
+                a = attempt(n_ops)
+            return a
         good, bad = None, None
         n = start
         while n <= cap and time.monotonic() - t_row < ROW_WALL_S:
-            a = attempt(n)
+            a = attempt_fair(n)
             if a["ok"]:
                 good, n = a, n * 2
             else:
@@ -430,7 +445,7 @@ def main():
             mid = round((good["n_ops"] + bad["n_ops"]) / 2, -3)
             mid = int(min(max(mid, good["n_ops"] + 1000),
                           bad["n_ops"] - 1000))
-            a = attempt(mid)
+            a = attempt_fair(mid)
             if a["ok"]:
                 good = a
             else:

@@ -105,7 +105,11 @@ class Linearizable(Checker):
     def prepare_history(self, client_hist):
         """Prepend the init ops as already-completed pairs ordered before
         every real op (negative indices). Both the direct check and
-        independent's batched per-key path go through this."""
+        independent's batched per-key path go through this, and both
+        must feed it the SAME selection of ops — ``history.client_ops``
+        (integer process ids only; the nemesis and log lines never
+        linearize). A nemesis-laced history must produce identical
+        verdicts on either path."""
         if not self.init_ops:
             return client_hist
         lo = min((o.get("index", 0) for o in client_hist), default=0)
@@ -122,11 +126,7 @@ class Linearizable(Checker):
 
     def check(self, test, hist, opts=None):
         from . import jax_wgl, linear, wgl
-        client_hist = [o for o in hist
-                       if isinstance(o.get("process"), int)
-                       or o.get("type") in ("invoke", "ok", "fail", "info")
-                       and o.get("process") != "nemesis"]
-        client_hist = self.prepare_history(client_hist)
+        client_hist = self.prepare_history(h.client_ops(hist))
         e, init_state = self.spec.encode(client_hist)
         algo = self.algorithm
         if algo == "wgl":

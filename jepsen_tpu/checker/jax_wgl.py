@@ -129,17 +129,25 @@ KEYED = (0, 1, 2, 3, 5, 6, 7, 8, 9, 10, 11)
 #: version tag hashed into checkpoint fingerprints: bump whenever the
 #: carry layout or table format changes, so snapshots from an older
 #: build are cleanly ignored instead of crashing the resume
-CARRY_LAYOUT = f"carry-v5:tab-interleaved,probes{PROBES},topk{TOPK},incfp"
+CARRY_LAYOUT = (f"carry-v6:tab-interleaved,probes{PROBES},topk{TOPK},"
+                "incfp,tfail")
 
-#: carry tuple indices (v5 layout; single source of truth for every
+#: carry tuple indices (v6 layout; single source of truth for every
 #: consumer -- hardcoded copies desynchronized once already when v2's
 #: split tables were merged). v5 adds buf_fp: per-config PARTIAL HASH
 #: SUMS over the lin bitset, updated O(1) per child instead of re-
 #: hashing all B words per lane per iteration (the profiled dominant
 #: cost at 100k+ ops -- see PROFILE.md round 4)
+#: ... v6 appends tfail: per-table-group count of configs that WANTED a
+#: dedup-table insert but found no empty slot in their probe window --
+#: safe (only re-exploration) but a throughput tell: a saturated table
+#: is otherwise indistinguishable from a slow search (VERDICT r4 #5)
 (IDX_BUF_LIN, IDX_BUF_STATE, IDX_BUF_FP, IDX_TOP, IDX_TAB, IDX_DROPPED,
  IDX_STATUS, IDX_EXPLORED, IDX_BEST_DEPTH, IDX_BEST_LIN, IDX_BEST_STATE,
- IDX_ITS, IDX_IT, IDX_CLAIM) = range(14)
+ IDX_ITS, IDX_IT, IDX_CLAIM, IDX_TFAIL) = range(15)
+
+#: number of carry tuple elements (shard_map specs, checkpoint loaders)
+N_CARRY = IDX_TFAIL + 1
 
 
 @functools.lru_cache(maxsize=64)
@@ -168,7 +176,8 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
     i32, explored (K,) i32, best_depth (K,TOPK) i32, best_lin (K,TOPK,B)
     u32, best_state (K,TOPK,S) i32 (TOPK distinct deepest-config witness
     slots, knossos's multi-:configs parity), its (K,) i32, it (G,) i32,
-    claim (G,Tc) i32 shared. G is the table-group count: 1 locally; under shard_map over a
+    claim (G,Tc) i32 shared, tfail (G,) i32 shared (dedup insert-failure
+    count; v6). G is the table-group count: 1 locally; under shard_map over a
     mesh, G = mesh size so each device shard sees exactly one group (the
     body always indexes group 0 of its local view). Buffers depend on O/B/S/T but NOT on W, so kernel variants with
     different frontier widths are interchangeable mid-search (the batch
@@ -277,7 +286,7 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
     def body(carry, consts):
         (buf_lin, buf_state, buf_fp, top, tabg, dropped, status,
          explored, best_depth, best_lin, best_state, its, it,
-         claimg) = carry
+         claimg, tfailg) = carry
         tab, claim = tabg[0], claimg[0]
         invoke, ret, fop, args, rets, ok_words, salt, bound = consts
         running = (status == RUNNING) & (top > 0)             # (K,)
@@ -608,10 +617,16 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
         first_empty = jnp.argmax(empty, axis=1)
         islot = jnp.take_along_axis(slots, first_empty[:, None],
                                     axis=1)[:, 0]
-        want = cv & ~dup & ~seen & empty.any(axis=1)
+        has_empty = empty.any(axis=1)
+        want = cv & ~dup & ~seen & has_empty
         wslot = jnp.where(want, islot, T)
         tab = tab.at[wslot].set(jnp.stack([h1, h2], axis=-1),
                                 mode="drop")
+        # saturation tell: lanes that wanted an insert but every probe
+        # slot was full (safe -- only re-exploration -- but it silently
+        # costs throughput, so it is counted and surfaced at harvest)
+        tfailg = tfailg.at[0].add(
+            jnp.sum(cv & ~dup & ~seen & ~has_empty, dtype=jnp.int32))
 
         # -- push fresh configs (per-key positions, one flat scatter) -------
         # Stack order (ascending position = popped sooner next time):
@@ -659,7 +674,7 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
         it = it + 1
         return (buf_lin, buf_state, buf_fp, top, tab[None], dropped,
                 status, explored, best_depth, best_lin, best_state, its,
-                it, claim[None])
+                it, claim[None], tfailg)
 
     def init_carry(init_states):
         buf_lin = jnp.zeros((K, O, B), jnp.uint32)
@@ -678,7 +693,8 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
                 jnp.zeros((K, TOPK, B), jnp.uint32),
                 jnp.zeros((K, TOPK, S), jnp.int32),
                 jnp.zeros(K, jnp.int32),
-                jnp.zeros(G, jnp.int32), jnp.zeros((G, Tc), jnp.int32))
+                jnp.zeros(G, jnp.int32), jnp.zeros((G, Tc), jnp.int32),
+                jnp.zeros(G, jnp.int32))
 
     def run_chunk(carry, invoke, ret, fop, args, rets, ok_words, salt,
                   bound):
@@ -703,6 +719,22 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
 
 # ---------------------------------------------------------------------------
 # public entry points
+
+def table_stats(carry):
+    """Dedup-table occupancy diagnostics (VERDICT r4 #5): load factor
+    from one reduction over the table at harvest time -- off the hot
+    loop -- plus the accumulated insert-failure count. Failed inserts
+    are safe (re-exploration only, never wrong answers) but silently
+    degrade throughput as the table fills; without these numbers a
+    saturated table is indistinguishable from a slow search."""
+    tab = carry[IDX_TAB]
+    used = int(jax.device_get(jnp.sum((tab != jnp.uint32(0)).any(-1),
+                                      dtype=jnp.int32)))
+    total = int(tab.shape[0] * tab.shape[1])
+    fails = int(np.asarray(jax.device_get(carry[IDX_TFAIL])).sum())
+    return {"table_load": round(used / total, 4),
+            "table_insert_failures": fails}
+
 
 def _bucket(x, lo):
     """Round up to a power of two (>= lo) so compiled searches are reused
@@ -1044,13 +1076,16 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
            "best_lin": carry[IDX_BEST_LIN][0],
            "best_state": carry[IDX_BEST_STATE][0]}
     out = jax.device_get(out)
+    tstats = table_stats(carry)
     if timed_out and int(out["status"]) == RUNNING and int(out["top"]) > 0:
         return {"valid": "unknown", "error": "timeout",
                 "configs_explored": int(out["explored"]),
                 "iterations": int(out["iterations"]), "engine": "jax-wgl",
+                **tstats,
                 **({"checkpoint": checkpoint} if checkpoint else {})}
     result = _interpret(spec, e, out, max_iters, confirm, init_state,
                         perm)
+    result.update(tstats)
     # never clobber a snapshot that belongs to a DIFFERENT check (the
     # mismatched-fingerprint case the load guard already ignores)
     if checkpoint is not None and _checkpoint_owned(checkpoint,

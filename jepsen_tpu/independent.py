@@ -20,6 +20,7 @@ import logging
 from dataclasses import dataclass, replace
 
 from . import generator as gen
+from . import history as h
 from .checker.core import Checker, as_checker, check_safe, merge_valid
 from .util import bounded_pmap
 
@@ -306,12 +307,13 @@ class _IndependentChecker(Checker):
             return None
         try:
             from .parallel import check_batch_encoded
+            # the SAME client-op selection as Linearizable.check runs
+            # through prepare_history here — the two paths once filtered
+            # differently and could diverge on exotic process values
             pairs = []
             for k in ks:
-                client = [o for o in subs[k]
-                          if isinstance(o.get("process"), int)]
                 pairs.append(lin.spec.encode(
-                    lin.prepare_history(client)))
+                    lin.prepare_history(h.client_ops(subs[k]))))
             batch = check_batch_encoded(lin.spec, pairs, **lin.engine_opts)
         except Exception:  # noqa: BLE001 - fall back to per-key path
             logger.warning("batched independent check failed; falling back",

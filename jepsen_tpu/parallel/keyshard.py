@@ -34,9 +34,9 @@ from ..checker import jax_wgl
 from ..checker.jax_wgl import (IDX_BEST_DEPTH, IDX_BEST_LIN,
                                IDX_BEST_STATE, IDX_DROPPED, IDX_EXPLORED,
                                IDX_ITS, IDX_STATUS, IDX_TOP, INF32, KEYED,
-                               RUNNING, _bucket, _build_search,
+                               N_CARRY, RUNNING, _bucket, _build_search,
                                _encode_arrays, _plan_sizes,
-                               max_point_concurrency)
+                               max_point_concurrency, table_stats)
 from ..history import INF_TIME
 
 logger = logging.getLogger(__name__)
@@ -80,7 +80,7 @@ def _dummy_key(n_pad, S_pad, A):
             None)
 
 
-def _shard_specs(mesh, n_carry=14, n_consts=8):
+def _shard_specs(mesh, n_carry=N_CARRY, n_consts=8):
     from jax.sharding import PartitionSpec as P
     ax = mesh.axis_names[0]
     carry_specs = tuple(P(ax) for _ in range(n_carry))
@@ -402,6 +402,10 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
             _save_batch_checkpoint(checkpoint, fingerprint, carry,
                                    alive, it, harvested)
 
+    # the dedup table is shared across keys (key-salted), so occupancy
+    # diagnostics are batch-wide: the same numbers go on every searched
+    # key's result (summed over table groups under a mesh)
+    tstats = table_stats(carry)
     for j, k in enumerate(live):
         per = harvested[j]
         if (timed_out and int(per["status"]) == RUNNING
@@ -413,6 +417,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
             results[k] = jax_wgl._interpret(spec, pairs[k][0], per,
                                             max_iters, False, pairs[k][1],
                                             perms[j])
+        results[k].update(tstats)
     return results
 
 

@@ -21,11 +21,18 @@ Rungs (BASELINE.md north-star table):
 The baseline is the sequential CPU WGL oracle (our knossos stand-in,
 checker/wgl.py) with a 60 s / config-capped budget per history.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}. Since
-round 3 the headline value is rung 2b's 256-key batch rate (rounds 1-2
-reported the 32-key rung 2 rate, still present in the detail for a
+Prints TWO JSON lines: the full detail blob first, then a SHORT
+headline-only line {"metric", "value", "unit", "vs_baseline",
+"headline_rung"} LAST -- the driver's tail capture must always catch a
+parseable headline (BENCH_r04's detail-first single line pushed the
+headline out of the captured tail, VERDICT r4 weak #1). Since round 3
+the headline value is the 256/1024-key batch rate (rounds 1-2 reported
+the 32-key rung 2 rate, still present in the detail for a
 like-for-like trend; vs_baseline divides by the single-thread CPU
-oracle rate measured on the 32-key subset).
+oracle rate measured on the 32-key subset). The batch rungs are timed
+as median-of-3 (single-shot points were stall-poisoned by TPU-tunnel
+hiccups: BENCH_r04's 256-key point read 2,622 ops/s against a stable
+~8k, VERDICT r4 weak #2); per-run times ship in the detail.
 """
 
 import json
@@ -36,6 +43,20 @@ import time
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
 ORACLE_BUDGET_S = 60.0
+
+
+def timed3(fn):
+    """Median-of-3 timed runs. Returns (median_s, sorted runs, last
+    result). The TPU tunnel stalls for whole minutes at a time
+    (observed single dispatches of 117-1029 s); a median over three
+    warm runs keeps one stall from poisoning a reported rate."""
+    runs = []
+    res = None
+    for _ in range(3):
+        t0 = time.monotonic()
+        res = fn()
+        runs.append(round(time.monotonic() - t0, 3))
+    return sorted(runs)[1], sorted(runs), res
 
 
 def _oracle_worker(spec_name, hist, q):
@@ -151,16 +172,16 @@ def main():
     # second warm run avoided entirely (4.3 s)
     check_batch_encoded(spec, pairs)
     check_batch_encoded(spec, pairs)
-    t0 = time.monotonic()
-    dev_results = check_batch_encoded(spec, pairs)
-    dev_s = time.monotonic() - t0
+    dev_s, runs2, dev_results = timed3(
+        lambda: check_batch_encoded(spec, pairs))
     agree = sum(1 for a, b in zip(base_results, dev_results)
                 if a["valid"] == b["valid"])
     dev_rate = total_ops / dev_s
     cpu_rate = total_ops / cpu_s
     rungs["2-cas-multikey"] = {
         "keys": n_keys, "total_ops": total_ops,
-        "device_s": round(dev_s, 3), "cpu_oracle_s": round(cpu_s, 3),
+        "device_s": round(dev_s, 3), "device_s_runs": runs2,
+        "cpu_oracle_s": round(cpu_s, 3),
         "device_rate": round(dev_rate, 1),
         "cpu_rate": round(cpu_rate, 1),
         "verdicts_agree": f"{agree}/{n_keys}",
@@ -184,13 +205,12 @@ def main():
     total2b = sum(len(e) for e, _ in pairs2b)
     check_batch_encoded(spec, pairs2b)        # compile warmups (x2:
     check_batch_encoded(spec, pairs2b)        # see rung 2)
-    t0 = time.monotonic()
-    res2b = check_batch_encoded(spec, pairs2b)
-    dev2b_s = time.monotonic() - t0
+    dev2b_s, runs2b, res2b = timed3(
+        lambda: check_batch_encoded(spec, pairs2b))
     rate2b = total2b / dev2b_s
     rungs["2b-cas-256key"] = {
         "keys": 256, "total_ops": total2b,
-        "device_s": round(dev2b_s, 3),
+        "device_s": round(dev2b_s, 3), "device_s_runs": runs2b,
         "device_rate": round(rate2b, 1),
         "invalid_keys": sum(1 for r in res2b if r["valid"] is False),
         "unknown_keys": sum(1 for r in res2b
@@ -212,13 +232,12 @@ def main():
     total2c = sum(len(e) for e, _ in pairs2c)
     check_batch_encoded(spec, pairs2c)        # compile warmups (x2:
     check_batch_encoded(spec, pairs2c)        # see rung 2)
-    t0 = time.monotonic()
-    res2c = check_batch_encoded(spec, pairs2c)
-    dev2c_s = time.monotonic() - t0
+    dev2c_s, runs2c, res2c = timed3(
+        lambda: check_batch_encoded(spec, pairs2c))
     rate2c = total2c / dev2c_s
     rungs["2c-cas-1024key"] = {
         "keys": 1024, "total_ops": total2c,
-        "device_s": round(dev2c_s, 3),
+        "device_s": round(dev2c_s, 3), "device_s_runs": runs2c,
         "device_rate": round(rate2c, 1),
         "invalid_keys": sum(1 for r in res2c if r["valid"] is False),
         "unknown_keys": sum(1 for r in res2c
@@ -370,8 +389,15 @@ def main():
         ("cas-register", "cas-register", cas_register_spec, 64, 0.05,
          16_000, 1_024_000),
         ("mutex", "mutex", mutex_spec, 64, 0.05, 8_000, 1_024_000),
+        # the aspect row's old 1.6M cap was the reported max (3.4 s
+        # decided -- the cap, not the engine, bound; VERDICT r4 #4).
+        # Measured scaling: the aspect check runs ~2.2 s per 1M ops
+        # (60 s budget would bind near ~26M), but host-side Python
+        # history generation + encode costs ~30 s per 1M ops, so the
+        # per-row wall binds first around 12.8M -- the honest,
+        # recorded failure mode (gen_s per probe documents it)
         ("fifo-queue-aspect", "fifo-queue", fifo_queue_spec, 64, 0.05,
-         200_000, 1_600_000),
+         200_000, 25_600_000),
         # the raw SEARCH engine on info-dequeue-bearing FIFO histories
         # (aspect disabled, like rung 4d): the honest search-path row
         ("fifo-queue-search", "fifo-queue", fifo_search, 16, 0.05,
@@ -387,10 +413,16 @@ def main():
             # bisection probes never shift each other's histories, and
             # rows stay independent across rounds
             seed = 77000 + _mi * 1_000_003 + n_ops
+            tg = time.monotonic()
             h0 = random_history(random.Random(seed), _mname,
                                 n_procs=_procs, n_ops=n_ops,
                                 crash_p=_crash)
             e0, st0 = _mspec.encode(h0)
+            # history generation + encode is host-side Python and grows
+            # linearly; at the aspect row's tens-of-millions-of-ops
+            # scale it becomes the binding constraint, so it is
+            # recorded separately from the (budgeted) check time
+            gen_s = round(time.monotonic() - tg, 1)
             try:
                 # 1-iteration probe: compiles the bucket's kernels
                 jax_wgl.check_encoded(_mspec, e0, st0, max_configs=1)
@@ -400,12 +432,16 @@ def main():
                 dt0 = time.monotonic() - t0
             except Exception as exc:  # noqa: BLE001 - e.g. device OOM
                 return {"n_ops": n_ops, "ops": len(e0), "s": None,
-                        "ok": False, "error": repr(exc)[:200]}
+                        "gen_s": gen_s, "ok": False,
+                        "error": repr(exc)[:200]}
             return {"n_ops": n_ops, "ops": len(e0),
-                    "s": round(dt0, 1),
+                    "s": round(dt0, 1), "gen_s": gen_s,
                     "ok": bool(r0["valid"] in (True, False)
                                and dt0 <= BUDGET_S),
                     "engine": r0.get("engine", "jax-wgl"),
+                    "table_load": r0.get("table_load"),
+                    "table_insert_failures":
+                        r0.get("table_insert_failures"),
                     "error": r0.get("error")}
 
         t_row = time.monotonic()
@@ -453,19 +489,29 @@ def main():
         entry = None
         if good is not None:
             entry = {"ops": good["ops"], "requested": good["n_ops"],
-                     "s": good["s"], "engine": good["engine"]}
+                     "s": good["s"], "gen_s": good["gen_s"],
+                     "engine": good["engine"]}
+            if good.get("table_load") is not None:
+                entry["table_load"] = good["table_load"]
+                entry["table_insert_failures"] = \
+                    good["table_insert_failures"]
             if bad is not None:
                 entry["first_fail"] = {
                     "requested": bad["n_ops"], "ops": bad["ops"],
-                    "s": bad["s"], "error": bad["error"]}
+                    "s": bad["s"], "gen_s": bad.get("gen_s"),
+                    "error": bad["error"]}
             elif good["n_ops"] * 2 > cap:
                 entry["cap_reached"] = cap
             else:
+                # the per-row wall bound before the 60 s check budget
+                # did; gen_s in the probes shows whether host-side
+                # history generation (not the engine) ate the wall
                 entry["row_budget_exhausted"] = True
         elif bad is not None:
             entry = {"ops": 0, "first_fail": {
                 "requested": bad["n_ops"], "ops": bad["ops"],
-                "s": bad["s"], "error": bad["error"]}}
+                "s": bad["s"], "gen_s": bad.get("gen_s"),
+                "error": bad["error"]}}
         maxlen[row] = entry
     rungs["0-maxlen-60s"] = maxlen
 
@@ -498,14 +544,18 @@ def main():
     headline_rung, headline = max(
         (("2b-cas-256key", rate2b), ("2c-cas-1024key", rate2c)),
         key=lambda kv: kv[1])
-    print(json.dumps({
+    head = {
         "metric": "ops verified/sec (cas-register)",
         "value": round(headline, 1),
         "unit": "ops/s",
         "vs_baseline": round(headline / cpu_rate, 3),
         "headline_rung": headline_rung,
-        "detail": rungs,
-    }))
+    }
+    # detail first, short headline-only line LAST: the driver captures
+    # the output's tail, and the detail blob once pushed the headline
+    # fields out of it (BENCH_r04 "parsed": null)
+    print(json.dumps({**head, "detail": rungs}))
+    print(json.dumps(head))
 
 
 if __name__ == "__main__":

@@ -152,7 +152,7 @@ N_CARRY = IDX_TFAIL + 1
 
 @functools.lru_cache(maxsize=64)
 def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
-                  NS=None):
+                  NS=None, rollout_kernel="auto"):
     """Compile the search for one shape bundle with an explicit key-batch
     axis K (jepsen.independent keys, BASELINE config 2). Returns jitted
 
@@ -228,6 +228,20 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
     KML = K * ML
     Tc = 1 << 16   # twin-claim scratch; fixed so carries are W-independent
 
+    # Fused Pallas rollout (VERDICT r4 #1): single-key searches only --
+    # the chain is their latency bottleneck (~8 ms busy / ~60 ms wall
+    # per iteration, PROFILE.md). "auto" engages it on real TPU when
+    # the shape fits VMEM; "pallas" forces it (interpret mode off-TPU,
+    # for tests); "scan" keeps the measured lax.scan path (the batch
+    # checker pins this -- its chip is filled by the key axis).
+    fused = None
+    if K == 1 and R and rollout_kernel != "scan":
+        on_tpu = jax.default_backend() == "tpu"
+        if rollout_kernel == "pallas" or on_tpu:
+            from . import pallas_rollout
+            fused = pallas_rollout.build_fused_rollout(
+                step_fn, NS, R, n, B, S, A, interpret=not on_tpu)
+
     step_one = lambda st, f, a, r: step_fn(st, f, a, r, jnp)  # noqa: E731
     # vmap over candidates (state shared), frontier rows, then keys
     step_vvv = jax.vmap(jax.vmap(jax.vmap(
@@ -288,7 +302,10 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
          explored, best_depth, best_lin, best_state, its, it,
          claimg, tfailg) = carry
         tab, claim = tabg[0], claimg[0]
-        invoke, ret, fop, args, rets, ok_words, salt, bound = consts
+        # fx: the fused rollout's pre-permuted op columns (empty tuple
+        # when the scan path is active), built once per dispatch in
+        # run_chunk -- never per iteration
+        (invoke, ret, fop, args, rets, ok_words, salt, bound, fx) = consts
         running = (status == RUNNING) & (top > 0)             # (K,)
 
         # -- pop per-key frontiers ------------------------------------------
@@ -514,7 +531,50 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
             return ((newlin, newst, alive, s1_r, s2_r),
                     (newlin, newst, alive, s1_r, s2_r))
 
-        if R:
+        if R and fused is not None:
+            # one kernel rolls all NS chains R steps with bitsets and
+            # states in VMEM; the per-step bitsets and incremental
+            # fingerprint sums are reconstructed OUT here with wide
+            # parallel ops (associative OR-scan over one-hot word
+            # masks) -- bit-identical to the scan path's carries, but
+            # without R sequential dispatch dependencies
+            j_rs, st_rs = fused[1](seed_lin[0], seed_st[0], seed_ok[0],
+                                   *fx)
+            jt = j_rs[None]                               # (1,NS,R)
+            took = jt >= 0
+            jc = jnp.maximum(jt, 0)
+            wselr = jnp.take(word_idx, jc)                # (1,NS,R)
+            bitr = jnp.uint32(1) << jnp.take(bit_idx, jc)
+            onehotw = (arange_B[None, None, None, :]
+                       == wselr[..., None].astype(jnp.uint32))
+            masks = jnp.where(onehotw & took[..., None],
+                              bitr[..., None], jnp.uint32(0))
+            cum = lax.associative_scan(jnp.bitwise_or, masks, axis=2)
+            ch_lin = seed_lin[:, :, None, :] | cum        # (1,NS,R,B)
+            prev_lin = jnp.concatenate(
+                [seed_lin[:, :, None, :], ch_lin[:, :, :-1]], axis=2)
+            # gather-free oldw: masked reduce over the B axis (per-key
+            # take_along_axis lowered to serialized scalar fusions
+            # once already -- see the witness-tracking note above)
+            oldw = jnp.sum(jnp.where(onehotw, prev_lin, jnp.uint32(0)),
+                           axis=3, dtype=jnp.uint32)      # (1,NS,R)
+            d1r, d2r = lin_deltas(oldw, oldw | bitr, wselr)
+            ch_s1 = seed_s1[:, :, None] + jnp.cumsum(
+                jnp.where(took, d1r, jnp.uint32(0)), axis=2,
+                dtype=jnp.uint32)
+            ch_s2 = seed_s2[:, :, None] + jnp.cumsum(
+                jnp.where(took, d2r, jnp.uint32(0)), axis=2,
+                dtype=jnp.uint32)
+            ch_st = st_rs[None]                           # (1,NS,R,S)
+            ch_alive = took
+            # flip the seed axis so the BEST seed's chain flattens to
+            # the LAST lanes (top of stack), as in the scan path below
+            ch_lin = ch_lin[:, ::-1].reshape(K, NS * R, B)
+            ch_st = ch_st[:, ::-1].reshape(K, NS * R, S)
+            ch_alive = ch_alive[:, ::-1].reshape(K, NS * R)
+            ch_s1 = ch_s1[:, ::-1].reshape(K, NS * R)
+            ch_s2 = ch_s2[:, ::-1].reshape(K, NS * R)
+        elif R:
             # unroll: the chain is LATENCY-bound (PROFILE.md: ~26 us
             # busy vs ~175 us wall per micro-step at n=131k -- the gap
             # is loop-boundary dispatch latency); unrolling fuses 8
@@ -538,6 +598,7 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
             ch_s2 = jnp.transpose(ch_s2, (1, 2, 0))[:, ::-1] \
                 .reshape(K, NS * R)
 
+        if R:
             okw2 = ok_words[:, None, :]
             ch_done = jnp.all((ch_lin & okw2) == okw2, axis=-1) & ch_alive
             status = jnp.where(running & ch_done.any(axis=1), VALID,
@@ -705,7 +766,10 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
 
         Op arrays must be pre-sorted into linearization-priority order
         (_priority_order): index order IS search order."""
-        consts = (invoke, ret, fop, args, rets, ok_words, salt, bound)
+        fx = (fused[0](invoke[0], ret[0], fop[0], args[0], rets[0])
+              if fused is not None else ())
+        consts = (invoke, ret, fop, args, rets, ok_words, salt, bound,
+                  fx)
 
         def cond(c):
             return jnp.any((c[IDX_STATUS] == RUNNING)
@@ -780,7 +844,12 @@ def _plan_sizes(n, S, C, frontier_width=None, stack_size=None,
         per = (B + S) * 4
         stack_size = max(4096, min(1 << 18, (128 << 20) // per))
     if table_size is None:
-        table_size = 1 << 20
+        # a fixed 2^20 table SATURATES at rung-0 scales (round-5
+        # instrumentation measured load 0.985 on a 64k-request cas
+        # search after only 194 iterations): failed inserts silently
+        # degrade the search to re-exploration. Scale with the history
+        # size -- ~32 slots per op -- capped at 2^23 (64 MB of HBM)
+        table_size = max(1 << 20, min(1 << 23, 32 * n))
     # slot indexing uses h & (T-1): every size must be a power of two
     return (B, _bucket(frontier_width, 8), _bucket(stack_size, 1024),
             _bucket(table_size, 1024))
@@ -929,7 +998,8 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
                   frontier_width=None, stack_size=None, table_size=None,
                   confirm=False, timeout_s=None, chunk_iters=256,
                   checkpoint=None, checkpoint_every_s=60.0, cancel=None,
-                  rollout_seeds=None):
+                  rollout_seeds=None, rollout_kernel="auto",
+                  rollout_depth=None):
     """Device WGL search over an EncodedHistory. Result dict mirrors
     wgl.check_encoded: {"valid": True|False|"unknown", "configs_explored",
     ...}, plus device budget diagnostics. ``timeout_s`` bounds wall clock
@@ -996,7 +1066,9 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     max_iters = max(1, max_configs // W)
 
     init_carry, run_chunk = _build_search(spec.step, 1, n_pad, B, S, C, A,
-                                          W, O, T, NS=rollout_seeds)
+                                          W, O, T, R=rollout_depth,
+                                          NS=rollout_seeds,
+                                          rollout_kernel=rollout_kernel)
     consts = (jnp.asarray(inv32[None]), jnp.asarray(ret32[None]),
               jnp.asarray(fop[None]), jnp.asarray(args[None]),
               jnp.asarray(rets[None]), jnp.asarray(ok_words[None]),

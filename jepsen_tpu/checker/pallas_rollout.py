@@ -1,0 +1,276 @@
+"""Fused greedy-rollout kernel for the single-key WGL search (Pallas/TPU).
+
+The single-key search is latency-bound end to end: PROFILE.md's round-4
+profile measured ~8 ms of leaf busy against ~60 ms of wall per
+iteration, most of the gap being the rollout ``lax.scan`` -- R=256
+sequential micro-steps, each a handful of tiny fused ops whose
+dispatch dependencies the XLA scheduler cannot overlap (~26 us busy vs
+~175 us wall per micro-step). This module collapses the whole chain
+into ONE Pallas kernel: the R-step loop runs inside the kernel with
+the chains' eligibility masks and model states resident in VMEM, so a
+micro-step costs its compute, not its dispatch.
+
+Scope (VERDICT r4 #1): single-key searches (K=1) on models whose step
+function is *plane-broadcastable* and whose padded state is small --
+register/cas/mutex, where S is a word or two. The FIFO search (S up
+to 8k after pad_state, gather-based step) falls back to the scan, as
+does any shape that would not fit the kernel's VMEM budget; the K>1
+batch path keeps the scan too (it pins NS=1 and is throughput-bound
+on the key axis, not latency-bound on the chain -- PROFILE.md).
+
+Mosaic-shaped design notes (each constraint below was hit for real):
+
+* Instead of the packed lin bitset, the kernel keeps an **unpacked
+  per-op "unlinearized" mask** (NCH, NS, CH) u32 resident in VMEM,
+  aliased input->output so it mutates in place. The first fused
+  design unpacked the bitset per chunk per step (32 shifted concats,
+  ~64 per micro-step); at n_pad=131k that made the kernel SLOWER than
+  the scan it replaced (~187 ms vs ~57 ms per search iteration).
+  With the mask resident, eligibility is one ref read, and the
+  per-step flip is a single masked full-tensor multiply.
+* No reshapes, no vmap, no rank-1 values, no bool carries or bool
+  minor-dim inserts, no dynamic_slice on values: Mosaic rejects or
+  miscompiles each (shape casts, i1 scf.for carries, i1 concats ->
+  invalid vreg bitcasts, rank-1 layouts). Everything in the kernel is
+  a rank-3 tensor; per-seed scalars ride as (1, NS, 1); chunk sweeps
+  are ``fori_loop``s over dynamic-sublane ref slices (a
+  Python-unrolled sweep kept every chunk's temporaries live at once
+  and blew the scoped-VMEM stack at n_pad=131k).
+* The model step is invoked ONCE per chunk on broadcastable planes
+  instead of vmap: ``state[s]`` is a (1, NS, 1) column, ``f``/
+  ``args[i]``/``ret[i]`` are (1, CH) rows, so the register/cas/mutex
+  step bodies (pure ``xp.where`` arithmetic) vectorize to
+  (1, NS, CH) with zero batching machinery. A numpy dry-run at build
+  time proves the model's step really is plane-broadcastable (and
+  rejects e.g. the FIFO's gather-based step), falling back to the
+  scan otherwise.
+
+Contract: the kernel returns, per seed chain, the op index chosen at
+every step (``-1`` once the chain wedges) and the model state after
+every step. The caller reconstructs the full per-step bitsets and
+incremental fingerprint sums OUTSIDE the kernel with wide parallel
+ops (an associative bitwise-or scan over one-hot word masks) -- those
+tensors are (NS, R, B) and would blow VMEM, but XLA chews through
+them at HBM bandwidth in a fixed number of large fused ops, which is
+exactly what the sequential scan could not do. The reconstruction is
+bit-identical to the ``lax.scan`` path (same greedy rule: first
+eligible op in priority order whose model step succeeds; same WGL
+eligibility ``unlinearized & invoke < min unlinearized return``).
+
+Reference anchor: this replaces the hot loop of the engine the
+reference outsources to knossos (jepsen/src/jepsen/checker.clj:199).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas is optional at import time: the scan path never needs it
+    from jax.experimental import pallas as pl
+except Exception:  # noqa: BLE001 - pragma: no cover
+    pl = None
+
+INF32 = np.int32(2**31 - 1)
+
+#: per-chunk op count for the in-kernel sweeps over the n ops; 16384
+#: i32 lanes keep every (1, NS, CH) temporary at 512 KB while halving
+#: the chunk-loop trip count vs 8192
+CHUNK = 16384
+
+#: conservative VMEM budget for resident inputs + temporaries; the
+#: core is ~16 MB and Mosaic needs headroom for double buffering
+VMEM_BUDGET = 11 << 20
+
+
+class _Planes:
+    """Indexable stand-in for a state/args/ret vector whose components
+    are broadcastable planes: ``planes[i]`` is component i as a
+    (1, NS, 1) or (1, CH) tensor. Step functions index components
+    (``state[0]``, ``args[1]``) and read ``state.dtype``; nothing
+    else is supported -- models that need more fail the build-time
+    dry-run and keep the scan path."""
+
+    def __init__(self, planes, dtype):
+        self._planes = list(planes)
+        self.dtype = dtype
+
+    def __getitem__(self, i):
+        return self._planes[i]
+
+    def __len__(self):
+        return len(self._planes)
+
+
+def fits(NS, R, n, S, A):
+    """Whether the fused kernel's working set fits the VMEM budget."""
+    ch = min(n, CHUNK)
+    resident = n * (3 + 2 * A) * 4          # invoke/ret/fop + args/rets
+    mask = NS * n * 4                       # unpacked eligibility mask
+    temps = NS * ch * (S + 6) * 4           # step planes + chunk masks
+    outs = R * (128 + S * 128) * 4          # lane-padded output tiles
+    return resident + mask + temps + outs <= VMEM_BUDGET
+
+
+def _broadcastable_step(step_fn, S, A):
+    """Numpy dry-run: does the model's step vectorize over broadcast
+    planes with the right output shapes? (register/cas/mutex do --
+    pure xp.where arithmetic; the FIFO's gather-based step does
+    not.)"""
+    ns, ch = 3, 8
+    try:
+        st = _Planes([np.zeros((1, ns, 1), np.int32) for _ in range(S)],
+                     np.int32)
+        f = np.zeros((1, ch), np.int32)
+        a = _Planes([np.zeros((1, ch), np.int32) for _ in range(A)],
+                    np.int32)
+        r = _Planes([np.zeros((1, ch), np.int32) for _ in range(A)],
+                    np.int32)
+        st2, ok = step_fn(st, f, a, r, np)
+        st2 = np.asarray(st2)
+        ok = np.asarray(ok)
+        if st2.shape[0] != S:
+            return False
+        np.broadcast_to(ok, (1, ns, ch))
+        for i in range(S):
+            np.broadcast_to(np.asarray(st2[i]), (1, ns, ch))
+        return True
+    except Exception:  # noqa: BLE001 - any failure means "not this path"
+        return False
+
+
+def build_fused_rollout(step_fn, NS, R, n, B, S, A, interpret=False):
+    """Compile the fused rollout for one shape bundle, or return None
+    when the shape/model cannot use it (caller keeps the scan path).
+
+    Returns ``(prep, run)``:
+
+        prep(invoke (n,), ret (n,), fop (n,), args (n,A), rets (n,A))
+            -> opaque tuple of device columns (call ONCE per dispatch,
+               outside the search while_loop)
+        run(seed_lin (NS,B) u32, seed_st (NS,S) i32, seed_ok (NS,)
+            bool, *prepped) -> (j (NS,R) i32, st (NS,R,S) i32)
+
+    where ``j[s,t]`` is the op linearized by chain ``s`` at step ``t``
+    (-1 from the step the chain wedges onward; dead-step states repeat
+    the last live state, mirroring the scan's frozen carries).
+    """
+    if pl is None or n % 32 or B != n // 32 or not fits(NS, R, n, S, A):
+        return None
+    CH = min(n, CHUNK)
+    if n % CH or CH % 32:
+        return None
+    if not _broadcastable_step(step_fn, S, A):
+        return None
+    NCH = n // CH
+
+    def prep(invoke, ret, fop, args, rets):
+        pv = lambda x: x.reshape(NCH, CH)  # noqa: E731
+        return ((pv(invoke), pv(ret), pv(fop))
+                + tuple(pv(args[:, i]) for i in range(A))
+                + tuple(pv(rets[:, i]) for i in range(A)))
+
+    def kernel(*refs):
+        (mask_in, seed_st, seed_ok, invoke, ret, fop) = refs[:6]
+        acols = refs[6:6 + A]
+        rcols = refs[6 + A:6 + 2 * A]
+        j_out, st_out, mask = refs[6 + 2 * A:]
+        del mask_in   # aliased to ``mask``: same buffer, initialized
+
+        # global op index per mask element (ops are in natural =
+        # priority order; no permutation needed with an unpacked mask)
+        gid3 = (lax.broadcasted_iota(jnp.int32, (NCH, NS, CH), 0) * CH
+                + lax.broadcasted_iota(jnp.int32, (NCH, NS, CH), 2))
+        g2 = lax.broadcasted_iota(jnp.int32, (1, NS, CH), 2)
+
+        def body(t, carry):
+            st, alive = carry                # (1,NS,S), (1,NS,1) i32
+
+            # pass A -- the WGL bound: min return over unlinearized ops
+            def rm_chunk(c, rm):
+                unl = mask[pl.ds(c, 1), :, :] != 0     # (1,NS,CH)
+                retc = ret[pl.ds(c, 1), :]             # (1, CH)
+                return jnp.minimum(rm, jnp.min(
+                    jnp.where(unl, retc, INF32), axis=2,
+                    keepdims=True))
+            rm = lax.fori_loop(
+                0, NCH, rm_chunk,
+                jnp.full((1, NS, 1), INF32, jnp.int32))
+
+            # pass B -- first eligible op in index (= priority) order
+            # whose model step succeeds, plus its post-step state
+            def choose_chunk(c, acc):
+                jf, stacc = acc
+                unl = mask[pl.ds(c, 1), :, :] != 0
+                elig = unl & (invoke[pl.ds(c, 1), :] < rm)
+                fc = fop[pl.ds(c, 1), :]
+                ap = _Planes([a[pl.ds(c, 1), :] for a in acols],
+                             jnp.int32)
+                rp = _Planes([r[pl.ds(c, 1), :] for r in rcols],
+                             jnp.int32)
+                sp = _Planes([st[:, :, i:i + 1] for i in range(S)],
+                             jnp.int32)
+                st2, okc = step_fn(sp, fc, ap, rp, jnp)
+                succ = elig & okc
+                g = g2 + c * CH
+                jloc = jnp.min(jnp.where(succ, g, n), axis=2,
+                               keepdims=True)          # (1,NS,1)
+                better = jloc < jf
+                # i32 multiply, not a bool-mask where: Mosaic cannot
+                # insert a minor dim on an i1 vector
+                pick32 = jnp.where(succ & (g == jloc) & better, 1, 0)
+                stn = jnp.concatenate(
+                    [jnp.sum(st2[i] * pick32, axis=2, keepdims=True)
+                     for i in range(S)], axis=2)       # (1,NS,S)
+                return (jnp.minimum(jf, jloc),
+                        jnp.where(better, stn, stacc))
+            jf, stacc = lax.fori_loop(
+                0, NCH, choose_chunk,
+                (jnp.full((1, NS, 1), n, jnp.int32),
+                 jnp.zeros((1, NS, S), jnp.int32)))
+
+            # ``alive`` rides the loop as i32: Mosaic fails to
+            # legalize an i1 vector as an scf.for carry
+            took = (jf < n) & (alive != 0)
+            # flip the chosen op out of the resident mask: one masked
+            # full-tensor multiply (jf broadcast against the global
+            # op-index iota)
+            flip = (gid3 == jnp.minimum(jf, n - 1)) & took
+            mask[:, :, :] = mask[:, :, :] * jnp.where(flip, 0, 1) \
+                .astype(jnp.uint32)
+            st = jnp.where(took, stacc, st)
+            alive = jnp.where(took, 1, 0)
+            j_out[pl.ds(t, 1), :, :] = jnp.where(took, jf, -1)
+            st_out[pl.ds(t, 1), :, :] = st
+            return st, alive
+
+        lax.fori_loop(0, R, body, (seed_st[:, :, :], seed_ok[:, :, :]))
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((R, NS, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((R, NS, S), jnp.int32),
+                   jax.ShapeDtypeStruct((NCH, NS, CH), jnp.uint32)),
+        input_output_aliases={0: 2},   # mask mutates in place
+        interpret=interpret,
+    )
+
+    bit_idx = (np.arange(n) % 32).astype(np.uint32)
+
+    def run(seed_lin, seed_st, seed_ok, *prepped):
+        # unpack the seed bitsets to the (NCH, NS, CH) mask in XLA
+        # (jnp.repeat and reshapes are fine OUTSIDE the kernel)
+        wbits = jnp.repeat(seed_lin, 32, axis=1)[:, :n]      # (NS, n)
+        unl = ((wbits >> bit_idx[None, :]) & jnp.uint32(1)) \
+            ^ jnp.uint32(1)
+        mask = jnp.transpose(unl.reshape(NS, NCH, CH), (1, 0, 2))
+        j_rs, st_rs, _ = call(mask, seed_st[None, :, :],
+                              seed_ok.astype(jnp.int32)[None, :, None],
+                              *prepped)
+        return (jnp.transpose(j_rs[:, :, 0], (1, 0)),
+                jnp.transpose(st_rs, (1, 0, 2)))
+
+    return prep, run

@@ -205,8 +205,12 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     def build_runner(Kc, Wc):
         """run_chunk for a (possibly compacted/resumed) batch width."""
         if mesh is None:
+            # the batch path keeps the lax.scan rollout even when a
+            # compaction shrinks it to one key: its NS=1 chain is not
+            # the bottleneck and the measured numbers are scan-based
             _, rb = _build_search(spec.step, Kc, n_pad, B, S_pad, C, A,
-                                  Wc, O, T, G, NS=rollout_seeds)
+                                  Wc, O, T, G, NS=rollout_seeds,
+                                  rollout_kernel="scan")
             return rb
         try:
             from jax import shard_map
@@ -217,7 +221,8 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         # and one table group per device
         _, run_local = _build_search(spec.step, Kc // G, n_pad, B,
                                      S_pad, C, A, Wc, O, T, 1,
-                                     NS=rollout_seeds)
+                                     NS=rollout_seeds,
+                                     rollout_kernel="scan")
         return jax.jit(shard_map(
             run_local.__wrapped__, mesh=mesh,
             in_specs=(carry_specs,) + const_specs,
@@ -273,7 +278,8 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     else:
         init_carry, run_chunk = _build_search(spec.step, K, n_pad, B,
                                               S_pad, C, A, W, O, T, G,
-                                              NS=rollout_seeds)
+                                              NS=rollout_seeds,
+                                              rollout_kernel="scan")
         run_b = build_runner(K, W) if mesh is not None else run_chunk
         carry = init_carry(init_states)
         if mesh is not None:

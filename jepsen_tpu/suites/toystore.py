@@ -33,12 +33,15 @@ from __future__ import annotations
 import random
 import socket
 
+import itertools
+
 from .. import checker as cc
 from .. import cli
 from .. import client as jclient
 from .. import control as c
 from .. import db as jdb
 from .. import generator as gen
+from .. import independent
 from .. import nemesis as jnemesis
 from .. import os as jos
 from .. import tests as tst
@@ -270,24 +273,34 @@ class ToystoreClient(jclient.Client):
     def invoke(self, test, op):
         out = dict(op)
         f = op["f"]
+        # independent-keys support (tutorial ch 6): a [k v] tuple value
+        # addresses key k; plain values use the classic single key "x"
+        v = op.get("value")
+        if independent.is_tuple(v):
+            key, payload = v.key, v.value
+        else:
+            key, payload = "x", v
         try:
             if f == "read":
-                resp = self._call(test, "R x")
+                resp = self._call(test, f"R {key}")
                 if resp.startswith("VAL"):
-                    v = resp.split()[1]
+                    rv = resp.split()[1]
+                    rv = None if rv == "nil" else int(rv)
                     out.update(type="ok",
-                               value=None if v == "nil" else int(v))
+                               value=independent.tuple_(key, rv)
+                               if independent.is_tuple(v) else rv)
                 else:
                     out.update(type="fail", error=resp)
             elif f == "write":
-                resp = self._call(test, f"W x {op['value']}")
+                resp = self._call(test, f"W {key} {payload}")
                 out["type"] = "ok" if resp == "OK" else "info"
                 if resp != "OK":
                     out["error"] = resp
             else:
-                old, new = op["value"]
+                old, new = payload
                 resp = self._call(
-                    test, f"CAS x {'nil' if old is None else old} {new}")
+                    test,
+                    f"CAS {key} {'nil' if old is None else old} {new}")
                 if resp == "OK":
                     out["type"] = "ok"
                 elif resp.startswith("FAIL"):
@@ -296,8 +309,57 @@ class ToystoreClient(jclient.Client):
                     out.update(type="info", error=resp)
         except OSError as e:
             # connection refused/timeout: reads definitely didn't
-            # happen; writes are indeterminate
+            # happen (idempotent -> safe to FAIL, keeping checker
+            # concurrency down -- tutorial ch 6); writes are
+            # indeterminate and must crash as info
             out.update(type="fail" if f == "read" else "info",
+                       error=repr(e))
+        return out
+
+
+class ToystoreSetClient(ToystoreClient):
+    """A grow-only set stored as a comma-joined string under one key,
+    added to with a read/CAS read-modify-write loop (the reference
+    tutorial's ``swap!`` pattern, doc/tutorial/08-set.md:209-228)."""
+
+    KEY = "s"
+
+    def open(self, test, node):
+        return ToystoreSetClient(node)
+
+    def invoke(self, test, op):
+        out = dict(op)
+        try:
+            if op["f"] == "read":
+                resp = self._call(test, f"R {self.KEY}")
+                if not resp.startswith("VAL"):
+                    out.update(type="fail", error=resp)
+                    return out
+                tok = resp.split()[1]
+                out.update(type="ok",
+                           value=[] if tok == "nil"
+                           else [int(x) for x in tok.split(",")])
+                return out
+            # add: read-modify-CAS until this writer wins the race; a
+            # spent contention budget is a clean FAIL (nothing acked)
+            v = op["value"]
+            for _ in range(16):
+                resp = self._call(test, f"R {self.KEY}")
+                if not resp.startswith("VAL"):
+                    out.update(type="fail", error=resp)
+                    return out
+                cur = resp.split()[1]
+                new = str(v) if cur == "nil" else f"{cur},{v}"
+                resp = self._call(test, f"CAS {self.KEY} {cur} {new}")
+                if resp == "OK":
+                    out["type"] = "ok"
+                    return out
+                if not resp.startswith("FAIL"):
+                    out.update(type="info", error=resp)
+                    return out
+            out.update(type="fail", error="cas-contention")
+        except OSError as e:
+            out.update(type="fail" if op["f"] == "read" else "info",
                        error=repr(e))
         return out
 
@@ -313,6 +375,81 @@ def w(test, ctx):
 def cas(test, ctx):
     return {"type": "invoke", "f": "cas",
             "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+
+# -- workloads (tutorial chapters 6-8; reference doc/tutorial/08-set.md
+# workload maps + etcdemo's register rewrite) --------------------------------
+
+def _register_checker(opts):
+    """The composed per-register checker both register workloads
+    share (linearizable gate + timeline)."""
+    return cc.compose({
+        "linear": cks.linearizable(
+            {"model": "cas-register",
+             "algorithm": opts.get("algorithm", "competition")}),
+        "timeline": timeline.html(),
+    })
+
+
+def register_workload(opts):
+    """Single linearizable register on key "x": the tutorial's chapters
+    1-5 workload, as a {client, checker, generator, final_generator}
+    map."""
+    rate = float(opts.get("rate", 20))
+    return {
+        "client": ToystoreClient(),
+        "checker": _register_checker(opts),
+        "generator": gen.stagger(1.0 / rate, gen.mix([r, w, cas])),
+        "final_generator": None,
+    }
+
+
+#: threads per key for the independent-keys register workload; the
+#: test's concurrency must be a multiple of this
+INDEP_GROUP = 2
+
+
+def register_indep_workload(opts):
+    """The chapter-6 lift: the same register test over MANY independent
+    keys via concurrent_generator; per-key subhistories are decided as
+    one batched device call when the algorithm is jax-wgl."""
+    rate = float(opts.get("rate", 20))
+    per_key = int(opts.get("ops-per-key", 30))
+    return {
+        "client": ToystoreClient(),
+        "checker": independent.checker(_register_checker(opts)),
+        "generator": independent.concurrent_generator(
+            INDEP_GROUP, itertools.count(),
+            lambda k: gen.limit(per_key, gen.stagger(
+                1.0 / rate, gen.mix([r, w, cas])))),
+        "final_generator": None,
+    }
+
+
+def set_workload(opts):
+    """Grow-only set: unique adds during faults, then heal and read
+    everything back once per thread (reference doc/tutorial/08-set.md;
+    checker.clj:240-291)."""
+    rate = float(opts.get("rate", 20))
+    counter = itertools.count(1)
+
+    def add(test, ctx):
+        return {"type": "invoke", "f": "add", "value": next(counter)}
+
+    return {
+        "client": ToystoreSetClient(),
+        "checker": cks.set_checker(),
+        "generator": gen.stagger(1.0 / rate, add),
+        "final_generator": gen.each_thread(gen.once(
+            {"type": "invoke", "f": "read", "value": None})),
+    }
+
+
+WORKLOADS = {
+    "register": register_workload,
+    "register-indep": register_indep_workload,
+    "set": set_workload,
+}
 
 
 def toystore_test(opts):
@@ -331,39 +468,70 @@ def toystore_test(opts):
             lambda test_, node: ToystoreDB().resume(test_, node))
     else:
         nem = jnemesis.noop
+    wname = opts.get("workload", "register")
+    if wname == "register-indep":
+        # concurrent_generator groups INDEP_GROUP threads per key and
+        # asserts the thread count divides evenly; the generic "1n"
+        # default (3 nodes -> 3 threads) would crash it out of the
+        # box, so round up to the next multiple
+        conc = int(opts.get("concurrency") or 2 * INDEP_GROUP)
+        conc += -conc % INDEP_GROUP
+        test["concurrency"] = max(conc, INDEP_GROUP)
+    workload = WORKLOADS[wname](opts)
+    nem_gen = (None if nemesis_mode == "none" else
+               gen.cycle(gen.sleep(2),
+                         {"type": "info", "f": "start"},
+                         gen.sleep(2),
+                         {"type": "info", "f": "stop"}))
+    main = gen.time_limit(
+        opts.get("time-limit", 8),
+        gen.nemesis(nem_gen, workload["generator"]))
+    if workload.get("final_generator") is not None:
+        # the chapter-8 shape: run the workload under faults, heal,
+        # wait for recovery, THEN run the final reads -- a final read
+        # racing the last adds (or a dead node) would misclassify
+        # in-flight elements as lost
+        generator = gen.phases(
+            main,
+            gen.log("healing cluster"),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.log("waiting for recovery"),
+            gen.sleep(float(opts.get("recovery-time", 1))),
+            gen.clients(workload["final_generator"]))
+    else:
+        generator = main
     test.update({
-        "name": "toystore",
+        # the parameters that change the test's MEANING go in its name
+        # (reference doc/tutorial/07-parameters.md: "etcd q=true set")
+        "name": ("toystore" if wname == "register"
+                 else f"toystore-{wname}")
+                + (" stale" if opts.get("stale") else ""),
         "ssh": {"local?": True},
         "os": jos.noop,
         "db": ToystoreDB(),
-        "client": ToystoreClient(),
+        "client": workload["client"],
         "nemesis": nem,
-        "generator": gen.time_limit(
-            opts.get("time-limit", 8),
-            gen.nemesis(
-                None if nemesis_mode == "none" else
-                gen.cycle(gen.sleep(2),
-                          {"type": "info", "f": "start"},
-                          gen.sleep(2),
-                          {"type": "info", "f": "stop"}),
-                gen.stagger(0.05, gen.mix([r, w, cas])))),
-        "checker": cc.compose({
-            "linear": cks.linearizable(
-                {"model": "cas-register",
-                 "algorithm": opts.get("algorithm", "competition")}),
-            "timeline": timeline.html(),
-        }),
+        "generator": generator,
+        "checker": workload["checker"],
     })
     return test
 
 
 def _opt_spec(parser):
+    parser.add_argument("--workload", default="register",
+                        choices=sorted(WORKLOADS))
     parser.add_argument("--algorithm", default="competition")
     parser.add_argument("--stale", action="store_true",
                         help="serve follower reads from the async local "
                              "copy (a real linearizability bug)")
     parser.add_argument("--nemesis-mode", default="kill",
                         choices=["kill", "pause", "none"])
+    parser.add_argument("--rate", type=float, default=20,
+                        help="approximate requests per second per "
+                             "thread")
+    parser.add_argument("--ops-per-key", type=int, default=30,
+                        help="per-key op budget for register-indep")
+    parser.add_argument("--recovery-time", type=float, default=1)
     parser.add_argument("--base-port", type=int, default=BASE_PORT)
 
 

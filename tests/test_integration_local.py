@@ -180,3 +180,33 @@ def test_toystore_pause_nemesis(tmp_path, mode):
         tmp_path, 37150, **{"nemesis-mode": mode, "time-limit": 5}))
     test = core.run(test)
     assert test["results"]["valid"] is True, test["results"]
+
+
+def test_toystore_set_workload_end_to_end(tmp_path):
+    """Tutorial chapter 8 live: unique adds under a pause nemesis, heal,
+    then every thread reads the set back; the set checker classifies
+    every element and nothing acknowledged may be lost."""
+    test = toystore.toystore_test(_opts(tmp_path, 37160, **{
+        "workload": "set", "nemesis-mode": "pause", "time-limit": 4}))
+    test = core.run(test)
+    res = test["results"]
+    assert res["valid"] is True, res
+    assert res["lost-count"] == 0
+    assert res["ok-count"] >= 5, res
+    hist = test["history"]
+    reads = [o for o in hist if o.get("type") == "ok"
+             and o.get("f") == "read"]
+    assert reads, "final reads ran after the heal phase"
+
+
+def test_toystore_register_indep_workload(tmp_path):
+    """Tutorial chapter 6 live: the register test lifted over
+    independent keys with concurrent_generator; ops carry [k v] tuples
+    and the per-key verdicts merge."""
+    test = toystore.toystore_test(_opts(tmp_path, 37170, **{
+        "workload": "register-indep", "nemesis-mode": "none",
+        "concurrency": 4, "time-limit": 4, "ops-per-key": 12}))
+    test = core.run(test)
+    res = test["results"]
+    assert res["valid"] is True, res
+    assert res["results"], "per-key verdicts present"

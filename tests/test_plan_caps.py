@@ -40,10 +40,11 @@ def test_batch_narrowing_never_raises_capped_width(monkeypatch):
     seen = {}
     orig = keyshard._build_search
 
-    def spy(step, K, n, B, S, C, A, W, O, T, G=1, R=None, NS=None):
+    def spy(step, K, n, B, S, C, A, W, O, T, G=1, R=None, NS=None,
+            **kw):
         seen.setdefault("calls", []).append(
             {"K": K, "W": W, "NS": NS, "C": C, "S": S})
-        return orig(step, K, n, B, S, C, A, W, O, T, G, R, NS)
+        return orig(step, K, n, B, S, C, A, W, O, T, G, R, NS, **kw)
 
     monkeypatch.setattr(keyshard, "_build_search", spy)
     rng = random.Random(1)

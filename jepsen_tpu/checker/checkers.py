@@ -134,8 +134,30 @@ class Linearizable(Checker):
         elif algo == "linear":
             a = linear.check_encoded(self.spec, e, init_state)
         elif algo == "jax-wgl":
-            a = jax_wgl.check_encoded(self.spec, e, init_state,
-                                      **self.engine_opts)
+            opts = dict(self.engine_opts)
+            mesh = opts.pop("mesh", None)
+            if mesh is not None:
+                # one SINGLE-key search sharded across the mesh
+                # (parallel/searchshard.py); the multi-key batched
+                # path takes mesh via independent's engine_opts.
+                # Forward only the options the sharded engine
+                # supports; warn-drop the rest rather than crash a
+                # whole check over e.g. a checkpoint path
+                from ..parallel import check_encoded_sharded
+                keep = {"max_configs", "frontier_width", "stack_size",
+                        "table_size", "timeout_s", "chunk_iters",
+                        "steal", "rollout_seeds"}
+                dropped = sorted(set(opts) - keep)
+                if dropped:
+                    logger.warning(
+                        "engine_opts %s are not supported by the "
+                        "mesh-sharded search; ignoring", dropped)
+                a = check_encoded_sharded(
+                    self.spec, e, init_state, mesh,
+                    **{k: v for k, v in opts.items() if k in keep})
+            else:
+                a = jax_wgl.check_encoded(self.spec, e, init_state,
+                                          **opts)
         else:
             a = self._competition(e, init_state)
         # truncate heavyweight fields (checker.clj:213-216: "writing

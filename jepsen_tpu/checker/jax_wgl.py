@@ -152,7 +152,8 @@ N_CARRY = IDX_TFAIL + 1
 
 @functools.lru_cache(maxsize=64)
 def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
-                  NS=None, rollout_kernel="auto"):
+                  NS=None, rollout_kernel="auto", axis_name=None,
+                  axis_size=1, steal=16):
     """Compile the search for one shape bundle with an explicit key-batch
     axis K (jepsen.independent keys, BASELINE config 2). Returns jitted
 
@@ -201,7 +202,18 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
         # (Round 2 used a 256-op cutoff, which left the multi-key batch
         # -- 200-op histories per key -- grinding one depth level per
         # iteration; lowering it to 64 cut rung 2 device time ~3x.)
-        R = 0 if n <= 64 else min(256, n)
+        # SINGLE-KEY searches run deep chains (R=1024): a deep rollout
+        # amortizes the expensive expansion/dedup iteration over 4x
+        # the depth, and the win holds on BOTH rollout kernels (A/B,
+        # rung-0 shapes: 144k-request cas 64.9 s / 1102 iterations at
+        # R=256 -> 29.2 s / 264 at R=1024 on the scan path; mutex
+        # 224k-request scan-R256 timed out at 90 s where fused-R1024
+        # decided in 28.3 s). Wedge-prone histories pay more wall per
+        # iteration for chains that die early, but those searches were
+        # undecidable at R=256 too. The BATCH path keeps R=256: its
+        # chip is filled by the key axis and (K, NS*R) push lanes
+        # scale with R.
+        R = 0 if n <= 64 else min(1024 if K == 1 else 256, n)
     if NS is None:
         # Greedy chains rolled per iteration, for SINGLE-KEY searches
         # only. On the latency-bound single-key chain (PROFILE.md rung
@@ -224,9 +236,6 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
         # rollout rather than risk the worker -- the search still
         # progresses one depth level per iteration
         R, NS = 0, 1
-    ML = M + NS * R
-    KML = K * ML
-    Tc = 1 << 16   # twin-claim scratch; fixed so carries are W-independent
 
     # Fused Pallas rollout (VERDICT r4 #1): single-key searches only --
     # the chain is their latency bottleneck (~8 ms busy / ~60 ms wall
@@ -241,6 +250,9 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
             from . import pallas_rollout
             fused = pallas_rollout.build_fused_rollout(
                 step_fn, NS, R, n, B, S, A, interpret=not on_tpu)
+    ML = M + NS * R
+    KML = K * ML
+    Tc = 1 << 16   # twin-claim scratch; fixed so carries are W-independent
 
     step_one = lambda st, f, a, r: step_fn(st, f, a, r, jnp)  # noqa: E731
     # vmap over candidates (state shared), frontier rows, then keys
@@ -728,6 +740,58 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
         top = top + cnt
         top = jnp.where(top >= 2 * O, top - O, top)
 
+        if axis_name is not None:
+            # -- single-search mesh sharding (SURVEY §7 step 9) ---------
+            # This kernel instance is ONE SHARD of a single search: the
+            # DFS stack/frontier is partitioned per device (K == 1
+            # locally), dedup tables are per-device (insert failures
+            # only cost re-exploration, so skipping cross-device dedup
+            # is sound), and the only cross-device traffic is a tiny
+            # per-iteration work-balance vector (all_gather of frontier
+            # sizes) plus a bounded hand-off of configs donated to a
+            # STARVING right neighbor over the ring (ppermute) -- the
+            # ICI-collective design SURVEY §5 promises, not a port of
+            # the reference's thread-pool parallelism
+            # (checker.clj:101-116).
+            D, H = axis_size, steal
+            me = lax.axis_index(axis_name)
+            loads = lax.all_gather(top[0], axis_name)         # (D,)
+            starving = jnp.take(loads, (me + 1) % D) == 0
+            donate = (top[0] > 2 * H) & starving \
+                & (status[0] == RUNNING)
+            # deepest H entries (ring positions top-1 .. top-H); a
+            # donor keeps plenty and the thief resumes depth-first
+            # from the donor's best configs
+            idxh = (top[0] - 1
+                    - jnp.arange(H, dtype=jnp.int32)) % O     # (H,)
+            hval = jnp.where(donate, 1, 0) \
+                * jnp.ones(H, jnp.int32)                      # (H,)
+            h_lin = jnp.take(buf_lin[0], idxh, axis=0)        # (H, B)
+            h_st = jnp.take(buf_state[0], idxh, axis=0)
+            h_fp = jnp.take(buf_fp[0], idxh, axis=0)
+            top = jnp.where(donate, top - H, top)
+            ring = [(i, (i + 1) % D) for i in range(D)]
+            r_lin = lax.ppermute(h_lin, axis_name, ring)
+            r_st = lax.ppermute(h_st, axis_name, ring)
+            r_fp = lax.ppermute(h_fp, axis_name, ring)
+            r_val = lax.ppermute(hval, axis_name, ring) != 0  # (H,)
+            # push the received configs (shallowest of the donation on
+            # the bottom: they arrive deepest-first, so reverse)
+            r_val = r_val[::-1]
+            cnt_r = jnp.sum(r_val, dtype=jnp.int32)
+            pos_r = top[0] + jnp.cumsum(
+                r_val.astype(jnp.int32)) - 1
+            dropped = dropped | ((status == RUNNING)
+                                 & (top + cnt_r > O))
+            fpos_r = jnp.where(r_val, pos_r % O, O)
+            buf_lin = buf_lin.reshape(O, B).at[fpos_r] \
+                .set(r_lin[::-1], mode="drop").reshape(K, O, B)
+            buf_state = buf_state.reshape(O, S).at[fpos_r] \
+                .set(r_st[::-1], mode="drop").reshape(K, O, S)
+            buf_fp = buf_fp.reshape(O, 2).at[fpos_r] \
+                .set(r_fp[::-1], mode="drop").reshape(K, O, 2)
+            top = top + cnt_r
+
         explored = explored + jnp.where(running,
                                         fvalid.sum(axis=1,
                                                    dtype=jnp.int32), 0)
@@ -772,9 +836,21 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
                   fx)
 
         def cond(c):
-            return jnp.any((c[IDX_STATUS] == RUNNING)
-                           & (c[IDX_TOP] > 0)) \
-                & (c[IDX_IT][0] < bound)
+            local = jnp.any((c[IDX_STATUS] == RUNNING)
+                            & (c[IDX_TOP] > 0))
+            if axis_name is None:
+                return local & (c[IDX_IT][0] < bound)
+            # sharded single search: every shard must agree on the
+            # loop trip count (a shard exiting early would desert the
+            # body's collectives), so continuation is GLOBAL -- any
+            # shard holding work keeps everyone stepping (starved
+            # shards idle until the ring feeds them), and any shard's
+            # success stops everyone
+            work = lax.psum(jnp.where(local, 1, 0), axis_name)
+            found = lax.psum(
+                jnp.sum((c[IDX_STATUS] == VALID).astype(jnp.int32)),
+                axis_name)
+            return (work > 0) & (found == 0) & (c[IDX_IT][0] < bound)
 
         return lax.while_loop(cond, lambda c: body(c, consts), carry)
 
@@ -994,29 +1070,17 @@ def _priority_order(spec, e, inv32, ret32):
     return perm, inv_s, ret_s, fop, args, rets, ok_words
 
 
-def check_encoded(spec, e, init_state, max_configs=50_000_000,
-                  frontier_width=None, stack_size=None, table_size=None,
-                  confirm=False, timeout_s=None, chunk_iters=256,
-                  checkpoint=None, checkpoint_every_s=60.0, cancel=None,
-                  rollout_seeds=None, rollout_kernel="auto",
-                  rollout_depth=None):
-    """Device WGL search over an EncodedHistory. Result dict mirrors
-    wgl.check_encoded: {"valid": True|False|"unknown", "configs_explored",
-    ...}, plus device budget diagnostics. ``timeout_s`` bounds wall clock
-    (checked between device chunks of ``chunk_iters`` iterations);
-    exceeding it yields {"valid": "unknown", "error": "timeout"}.
-
-    ``checkpoint`` names a file the search frontier is periodically
-    snapshotted to (every ``checkpoint_every_s``, between chunks) — the
-    checkpoint/resume capability for long checks (SURVEY.md §5; the
-    reference has nothing comparable, its unit of durability is a whole
-    phase). A timed-out/killed check rerun with the same arguments
-    resumes from the snapshot instead of restarting; snapshots carry a
-    fingerprint of the search inputs so a stale file for a different
-    history or plan is ignored."""
+def _prepare_search(spec, e, init_state, confirm=False):
+    """Shared host-side preparation for a single-key search: empty/fast
+    paths, prune, priority order, padding to power-of-two buckets,
+    state padding. Returns ``("fast", result)`` when a polynomial path
+    decided the history, else ``("search", (perm, inv32, ret32, fop,
+    args, rets, ok_words, init_state, n_pad, C, A, S))``. Used by both
+    the single-chip path below and the mesh-sharded single search
+    (parallel/searchshard.py)."""
     n = len(e)
     if n == 0 or e.n_ok == 0:
-        return {"valid": True, "configs_explored": 0}
+        return ("fast", {"valid": True, "configs_explored": 0})
 
     inv32, ret32, _ = _encode_arrays(e)
     if spec.fast_check is not None:
@@ -1024,11 +1088,13 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
         if fast is not None:
             # exact polynomial decision (e.g. queue bad patterns) --
             # no search needed at any history size
-            return _fast_result(spec, e, init_state, fast, confirm)
+            return ("fast", _fast_result(spec, e, init_state, fast,
+                                         confirm))
     if spec.pad_state is None:   # fixed small state spaces only
         fast = _state_abstraction_check(spec, e, init_state)
         if fast is not None:
-            return _fast_result(spec, e, init_state, fast, confirm)
+            return ("fast", _fast_result(spec, e, init_state, fast,
+                                         confirm))
     inv32, ret32 = _apply_prune(spec, e, inv32, ret32)
     C = max_point_concurrency(inv32, np.where(ret32 == INF32,
                                               INF_TIME, ret32.astype(np.int64)))
@@ -1057,6 +1123,35 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
         S_pad = _bucket(init_state.shape[0], 2)
         init_state = np.asarray(spec.pad_state(init_state, S_pad), np.int32)
     S = int(init_state.shape[0])
+    return ("search", (perm, inv32, ret32, fop, args, rets, ok_words,
+                       init_state, n_pad, C, A, S))
+
+
+def check_encoded(spec, e, init_state, max_configs=50_000_000,
+                  frontier_width=None, stack_size=None, table_size=None,
+                  confirm=False, timeout_s=None, chunk_iters=256,
+                  checkpoint=None, checkpoint_every_s=60.0, cancel=None,
+                  rollout_seeds=None, rollout_kernel="auto",
+                  rollout_depth=None):
+    """Device WGL search over an EncodedHistory. Result dict mirrors
+    wgl.check_encoded: {"valid": True|False|"unknown", "configs_explored",
+    ...}, plus device budget diagnostics. ``timeout_s`` bounds wall clock
+    (checked between device chunks of ``chunk_iters`` iterations);
+    exceeding it yields {"valid": "unknown", "error": "timeout"}.
+
+    ``checkpoint`` names a file the search frontier is periodically
+    snapshotted to (every ``checkpoint_every_s``, between chunks) — the
+    checkpoint/resume capability for long checks (SURVEY.md §5; the
+    reference has nothing comparable, its unit of durability is a whole
+    phase). A timed-out/killed check rerun with the same arguments
+    resumes from the snapshot instead of restarting; snapshots carry a
+    fingerprint of the search inputs so a stale file for a different
+    history or plan is ignored."""
+    prep = _prepare_search(spec, e, init_state, confirm)
+    if prep[0] == "fast":
+        return prep[1]
+    (perm, inv32, ret32, fop, args, rets, ok_words, init_state, n_pad,
+     C, A, S) = prep[1]
 
     B, W, O, T = _plan_sizes(n_pad, S, C, frontier_width, stack_size,
                              table_size)

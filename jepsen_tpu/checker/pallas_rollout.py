@@ -86,6 +86,30 @@ CHUNK = 16384
 VMEM_BUDGET = 11 << 20
 
 
+class _KernelXP:
+    """jnp facade handed to model step functions inside the kernel.
+    Overrides ``where`` to rewrite bool-branch selects into mask
+    algebra: ``where(c, a, b)`` with boolean branches (the mutex ok
+    formula) otherwise lowers through an i8 vector Mosaic cannot
+    truncate to i1 ("Unsupported target bitwidth for truncation")."""
+
+    def __getattr__(self, name):
+        return getattr(jnp, name)
+
+    @staticmethod
+    def where(c, x, y):
+        xb = getattr(x, "dtype", None) == jnp.bool_ or isinstance(x, bool)
+        yb = getattr(y, "dtype", None) == jnp.bool_ or isinstance(y, bool)
+        if xb or yb:
+            xm = x if xb else jnp.asarray(x) != 0
+            ym = y if yb else jnp.asarray(y) != 0
+            return (c & xm) | (~c & ym)
+        return jnp.where(c, x, y)
+
+
+_kernel_xp = _KernelXP()
+
+
 class _Planes:
     """Indexable stand-in for a state/args/ret vector whose components
     are broadcastable planes: ``planes[i]`` is component i as a
@@ -105,14 +129,24 @@ class _Planes:
         return len(self._planes)
 
 
-def fits(NS, R, n, S, A):
-    """Whether the fused kernel's working set fits the VMEM budget."""
-    ch = min(n, CHUNK)
+def _fits_ch(NS, R, n, S, A, ch):
     resident = n * (3 + 2 * A) * 4          # invoke/ret/fop + args/rets
     mask = NS * n * 4                       # unpacked eligibility mask
     temps = NS * ch * (S + 6) * 4           # step planes + chunk masks
     outs = R * (128 + S * 128) * 4          # lane-padded output tiles
     return resident + mask + temps + outs <= VMEM_BUDGET
+
+
+def _pick_chunk(NS, R, n, S, A):
+    """Largest chunk whose temporaries fit alongside the resident
+    arrays (smaller chunks trade loop-trip overhead for VMEM); None
+    when even the smallest doesn't fit."""
+    for ch in (CHUNK, CHUNK // 2, CHUNK // 4):
+        ch = min(n, ch)
+        if n % ch == 0 and ch % 32 == 0 and _fits_ch(NS, R, n, S, A,
+                                                     ch):
+            return ch
+    return None
 
 
 def _broadcastable_step(step_fn, S, A):
@@ -158,10 +192,10 @@ def build_fused_rollout(step_fn, NS, R, n, B, S, A, interpret=False):
     (-1 from the step the chain wedges onward; dead-step states repeat
     the last live state, mirroring the scan's frozen carries).
     """
-    if pl is None or n % 32 or B != n // 32 or not fits(NS, R, n, S, A):
+    if pl is None or n % 32 or B != n // 32:
         return None
-    CH = min(n, CHUNK)
-    if n % CH or CH % 32:
+    CH = _pick_chunk(NS, R, n, S, A)
+    if CH is None:
         return None
     if not _broadcastable_step(step_fn, S, A):
         return None
@@ -213,7 +247,7 @@ def build_fused_rollout(step_fn, NS, R, n, B, S, A, interpret=False):
                              jnp.int32)
                 sp = _Planes([st[:, :, i:i + 1] for i in range(S)],
                              jnp.int32)
-                st2, okc = step_fn(sp, fc, ap, rp, jnp)
+                st2, okc = step_fn(sp, fc, ap, rp, _kernel_xp)
                 succ = elig & okc
                 g = g2 + c * CH
                 jloc = jnp.min(jnp.where(succ, g, n), axis=2,

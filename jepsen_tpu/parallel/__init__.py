@@ -9,3 +9,5 @@ dimension sharded over a ``jax.sharding.Mesh`` (SURVEY.md section 5
 """
 
 from .keyshard import check_batch_encoded, check_batch_histories  # noqa: F401
+from .searchshard import (check_encoded_sharded,  # noqa: F401
+                          check_history_sharded)

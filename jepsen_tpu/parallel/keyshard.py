@@ -175,6 +175,12 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         # batch compacted down to one key (or mesh shards of one key
         # each) can't silently flip into the single-key NS=8 regime.
         rollout_seeds = 1
+    # likewise pin the batch rollout depth: the single-key default
+    # deepened to R=1024 in round 5 (fused-kernel regime), but on the
+    # batch's NS=1 scan chains a deep rollout is 4x the wall per
+    # iteration exactly where straggler chains wedge -- keep the
+    # measured R=256, including for a batch compacted down to one key
+    R_batch = 0 if n_pad <= 64 else min(256, n_pad)
 
     cols = [_pad_key(pairs[k][0], pairs[k][1], spec, n_pad, S_pad, A,
                      encs[k])
@@ -209,7 +215,8 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
             # compaction shrinks it to one key: its NS=1 chain is not
             # the bottleneck and the measured numbers are scan-based
             _, rb = _build_search(spec.step, Kc, n_pad, B, S_pad, C, A,
-                                  Wc, O, T, G, NS=rollout_seeds,
+                                  Wc, O, T, G, R=R_batch,
+                                  NS=rollout_seeds,
                                   rollout_kernel="scan")
             return rb
         try:
@@ -221,7 +228,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         # and one table group per device
         _, run_local = _build_search(spec.step, Kc // G, n_pad, B,
                                      S_pad, C, A, Wc, O, T, 1,
-                                     NS=rollout_seeds,
+                                     R=R_batch, NS=rollout_seeds,
                                      rollout_kernel="scan")
         return jax.jit(shard_map(
             run_local.__wrapped__, mesh=mesh,
@@ -278,6 +285,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     else:
         init_carry, run_chunk = _build_search(spec.step, K, n_pad, B,
                                               S_pad, C, A, W, O, T, G,
+                                              R=R_batch,
                                               NS=rollout_seeds,
                                               rollout_kernel="scan")
         run_b = build_runner(K, W) if mesh is not None else run_chunk
@@ -294,6 +302,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     t0 = _time.monotonic()
     last_ckpt = t0
     timed_out = False
+    n_compactions = 0
     # adaptive dispatch quantum (jax_wgl._adapt_quantum, shared with
     # the single-key loop): calibrated from the measured per-iteration
     # wall. The batch targets ~1 s per dispatch (shorter than the
@@ -366,6 +375,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         # carries are W-independent, so the wider kernel picks up the
         # straggler's stack and dedup table as-is.
         if len(alive) > G and n_run <= len(alive) // 2:
+            n_compactions += 1
             done_rows = [r for r in range(len(alive)) if not running[r]]
             harvest(done_rows, carry)
             keep = [r for r in range(len(alive)) if running[r]]
@@ -424,6 +434,9 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
                                             max_iters, False, pairs[k][1],
                                             perms[j])
         results[k].update(tstats)
+        # batch-wide diagnostic: how often stragglers were compacted
+        # (and, under a mesh, resharded) during this run
+        results[k]["compactions"] = n_compactions
     return results
 
 

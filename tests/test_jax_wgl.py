@@ -189,7 +189,7 @@ def test_fused_pallas_rollout_matches_scan(spec_name):
     rng = random.Random(45100)
     engaged = 0
     for trial in range(6):
-        hist = _random_history(rng, spec_name, n_procs=6, n_ops=100,
+        hist = _random_history(rng, spec_name, n_procs=6, n_ops=220,
                                crash_p=0.05)
         if trial % 2:
             hist = _corrupt(rng, hist)
@@ -199,8 +199,14 @@ def test_fused_pallas_rollout_matches_scan(spec_name):
                     o["value"] = o["value"] % 4
         e, st = spec.encode(hist)
         scan = jax_wgl.check_encoded(spec, e, st, rollout_kernel="scan")
+        # same depth as the single-key default (0 below the 64-op
+        # cutoff, else min(1024, n_pad)): the chains must match
+        # bit-for-bit, so iteration counts are identical
+        n_pad = jax_wgl._bucket(len(e), 64)
+        depth = 0 if n_pad <= 64 else min(1024, n_pad)
         fused = jax_wgl.check_encoded(spec, e, st,
-                                      rollout_kernel="pallas")
+                                      rollout_kernel="pallas",
+                                      rollout_depth=depth)
         assert fused["valid"] == scan["valid"], trial
         assert fused.get("iterations") == scan.get("iterations"), trial
         if scan.get("engine") == "jax-wgl":

@@ -447,16 +447,25 @@ def main():
         t_row = time.monotonic()
 
         def attempt_fair(n_ops):
-            """One retry when a not-ok probe grossly overshot the
-            budget (>1.5x) -- whether it timed out or decided too
-            late: the adaptive quantum calibrates from measured
-            per-iteration wall, so a mid-probe tunnel hiccup can burn
-            the window without giving the search a fair 60 s; deciding
-            on retry proves 60 s decidability honestly. Skipped once
-            the row wall is spent (a retry would double the overrun)."""
+            """One retry when a not-ok probe either errored outright
+            (s None: the remote-compile service 500s flakily -- a
+            rehearsal recorded one as a fail bracket for a shape that
+            had compiled fine minutes earlier) or grossly overshot
+            the budget (>1.5x) -- the adaptive quantum calibrates
+            from measured per-iteration wall, so a mid-probe tunnel
+            hiccup can burn the window without giving the search a
+            fair 60 s; deciding on retry proves 60 s decidability
+            honestly. Skipped once the row wall is spent (a retry
+            would double the overrun)."""
             a = attempt(n_ops)
-            if (not a["ok"] and a["s"] is not None
-                    and a["s"] > BUDGET_S * 1.5
+            # deterministic resource failures are not flaky: retrying
+            # an OOM-sized probe would just OOM again and eat the row
+            # wall the bisection needs
+            oom = any(t in (a.get("error") or "")
+                      for t in ("RESOURCE_EXHAUSTED", "Out of memory",
+                                "out of memory"))
+            flaky = a["s"] is None or a["s"] > BUDGET_S * 1.5
+            if (not a["ok"] and flaky and not oom
                     and time.monotonic() - t_row < ROW_WALL_S):
                 a = attempt(n_ops)
             return a

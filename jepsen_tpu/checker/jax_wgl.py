@@ -868,12 +868,15 @@ def table_stats(carry):
     degrade throughput as the table fills; without these numbers a
     saturated table is indistinguishable from a slow search."""
     tab = carry[IDX_TAB]
-    used = int(jax.device_get(jnp.sum((tab != jnp.uint32(0)).any(-1),
-                                      dtype=jnp.int32)))
+    # ONE host round-trip for both scalars: a separate device_get per
+    # stat cost ~0.2 s each over the remote-TPU tunnel, a fixed
+    # per-check overhead that measurably dented the small batch rungs
+    used, fails = jax.device_get(
+        (jnp.sum((tab != jnp.uint32(0)).any(-1), dtype=jnp.int32),
+         jnp.sum(carry[IDX_TFAIL])))
     total = int(tab.shape[0] * tab.shape[1])
-    fails = int(np.asarray(jax.device_get(carry[IDX_TFAIL])).sum())
-    return {"table_load": round(used / total, 4),
-            "table_insert_failures": fails}
+    return {"table_load": round(int(used) / total, 4),
+            "table_insert_failures": int(fails)}
 
 
 def _bucket(x, lo):

@@ -383,7 +383,11 @@ def main():
     import dataclasses
     fifo_search = dataclasses.replace(fifo_queue_spec, fast_check=None)
     BUDGET_S = 60.0
-    ROW_WALL_S = 480.0   # per-row cap on total probe time
+    # per-row cap on total probe time. 600 s leaves room for one
+    # monster tunnel stall (observed: a single 256k-request dispatch
+    # running 418 s against a 60 s budget) plus the retry + bisection
+    # probes that rescue the bracket afterwards
+    ROW_WALL_S = 600.0
     rows0 = (
         # (row key, model name, spec, procs, crash_p, start, cap)
         ("cas-register", "cas-register", cas_register_spec, 64, 0.05,

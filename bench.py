@@ -108,7 +108,42 @@ class OracleRace:
         return out
 
 
+def _error_headline(msg):
+    """The zero-value headline shape every bench failure path emits
+    (one definition so error lines can't drift from success lines)."""
+    return json.dumps({"metric": "ops verified/sec (cas-register)",
+                       "value": 0.0, "unit": "ops/s",
+                       "vs_baseline": 0.0, "error": msg})
+
+
+def _device_preflight(timeout_s=240, tries=2):
+    """The remote-TPU tunnel can go fully down for hours (observed:
+    >2 h in round 5), and jax backend init then HANGS rather than
+    erroring. Probe it in a killable child first so a dead tunnel
+    yields a parseable headline line instead of an eternal hang.
+    One retry distinguishes a transient stall (e.g. another process
+    briefly holding the chip) from a real outage."""
+    import subprocess
+    err = None
+    for _ in range(tries):
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s, capture_output=True, text=True)
+            if p.returncode == 0:
+                return None
+            err = (p.stderr.strip()[-300:] or "backend init failed")
+        except subprocess.TimeoutExpired:
+            err = (f"backend init hung >{timeout_s}s twice "
+                   "(tunnel down or chip held)")
+    return err
+
+
 def main():
+    err = _device_preflight()
+    if err:
+        print(_error_headline(f"TPU unavailable: {err}"))
+        return
     # persistent compile cache: the kernel's shape buckets are designed
     # for reuse, and remote-compile latency is highly variable (~20-70 s
     # cold for the big FIFO shapes) -- without this, compile variance
@@ -548,10 +583,7 @@ def main():
         and rungs["5-cas-10k-64proc"]["cpu_valid"] == "unknown")
 
     if agree != n_keys:
-        print(json.dumps({"metric": "ops verified/sec (cas-register)",
-                          "value": 0.0, "unit": "ops/s",
-                          "vs_baseline": 0.0,
-                          "error": f"verdict mismatch: {agree}/{n_keys}"}))
+        print(_error_headline(f"verdict mismatch: {agree}/{n_keys}"))
         return
 
     headline_rung, headline = max(

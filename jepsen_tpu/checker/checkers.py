@@ -16,6 +16,7 @@ import re
 import threading
 
 from .. import history as h
+from .. import obs
 from ..models import base as mbase
 from ..util import nanos_to_secs
 from .core import Checker, compose, merge_valid
@@ -247,6 +248,10 @@ class Linearizable(Checker):
             t.join(timeout=0.5)
         r = dict(r)
         r["engine"] = name
+        if obs.enabled():
+            obs.inc("checker.competition_wins", engine=name)
+            obs.instant("checker.competition", cat="checker",
+                        winner=name, valid=str(r.get("valid")))
         return r
 
 

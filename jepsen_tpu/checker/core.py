@@ -11,6 +11,7 @@ import threading
 import traceback
 
 from .. import history as h
+from .. import obs
 from ..util import real_pmap
 
 __all__ = ["Checker", "check", "check_safe", "compose", "concurrency_limit",
@@ -70,18 +71,34 @@ def as_checker(c) -> Checker:
     raise TypeError(f"not a checker: {c!r}")
 
 
+def checker_name(checker):
+    """Human-readable checker name for spans/metrics."""
+    return getattr(checker, "name", None) or type(checker).__name__
+
+
 def check(checker, test, hist, opts=None):
     return as_checker(checker).check(test, h.ensure_indexed(hist), opts or {})
 
 
 def check_safe(checker, test, hist, opts=None):
     """Like check, but exceptions become {"valid": "unknown"}
-    (checker.clj:74-85)."""
+    (checker.clj:74-85). Every (sub)checker run — Compose fans out
+    through here too — gets a trace span + latency observation."""
+    name = checker_name(checker)
+    t0 = obs.now_ns()
     try:
-        return check(checker, test, hist, opts)
+        result = check(checker, test, hist, opts)
     except Exception:  # noqa: BLE001 - mirrors reference behavior
-        return {"valid": "unknown",
-                "error": traceback.format_exc()}
+        result = {"valid": "unknown",
+                  "error": traceback.format_exc()}
+    if obs.enabled():
+        dur = obs.now_ns() - t0
+        obs.complete(f"checker.{name}", t0, dur, cat="checker",
+                     valid=str(result.get("valid")))
+        obs.observe("checker.check_s", dur / 1e9, checker=name)
+        obs.inc("checker.checks", checker=name,
+                valid=str(result.get("valid")))
+    return result
 
 
 class Compose(Checker):

@@ -57,6 +57,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..history import INF_TIME
+from ..obs import search as obs_search
 
 INF32 = np.int32(2**31 - 1)
 
@@ -1197,6 +1198,9 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     t0 = _time.monotonic()
     last_ckpt = t0
     timed_out = False
+    # sinks captured ONCE at search start: a competition-abandoned
+    # straggler must not write into a later run's artifacts
+    so = obs_search.capture()
     it = int(carry[IDX_IT][0])
     # Adaptive dispatch quantum. ``chunk_iters`` is the CAP (explicit
     # tiny values are a cadence contract the checkpoint tests rely
@@ -1217,9 +1221,21 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
         t_chunk = _time.monotonic()
         bound = min(it + eff, max_iters)
         carry = run_chunk(carry, *consts, jnp.int32(bound))
-        status, top, it = (int(carry[IDX_STATUS][0]),
-                           int(carry[IDX_TOP][0]),
-                           int(carry[IDX_IT][0]))
+        # ONE host round-trip for all four scalars (separate device_gets
+        # cost ~0.2 s each over the remote-TPU tunnel; see table_stats)
+        status, top, it, explored = (
+            int(x) for x in jax.device_get(
+                (carry[IDX_STATUS][0], carry[IDX_TOP][0],
+                 carry[IDX_IT][0], carry[IDX_EXPLORED][0])))
+        # heartbeat per dispatch: long searches stop being a silent jit
+        # black box (frontier depth + cumulative explored, streamed to
+        # the captured tracer/registry; no-op when obs is unbound, and
+        # no extra device reads either way — the scalars ride the
+        # batched device_get above)
+        so.heartbeat(
+            "jax-wgl", iteration=it,
+            chunk_s=_time.monotonic() - t_chunk, frontier=top,
+            explored=explored)
         if status != RUNNING or top == 0 or it >= max_iters:
             break
         now = _time.monotonic()
@@ -1248,14 +1264,17 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     out = jax.device_get(out)
     tstats = table_stats(carry)
     if timed_out and int(out["status"]) == RUNNING and int(out["top"]) > 0:
-        return {"valid": "unknown", "error": "timeout",
-                "configs_explored": int(out["explored"]),
-                "iterations": int(out["iterations"]), "engine": "jax-wgl",
-                **tstats,
-                **({"checkpoint": checkpoint} if checkpoint else {})}
+        result = {"valid": "unknown", "error": "timeout",
+                  "configs_explored": int(out["explored"]),
+                  "iterations": int(out["iterations"]),
+                  "engine": "jax-wgl", **tstats,
+                  **({"checkpoint": checkpoint} if checkpoint else {})}
+        so.summary("jax-wgl", result)
+        return result
     result = _interpret(spec, e, out, max_iters, confirm, init_state,
                         perm)
     result.update(tstats)
+    so.summary("jax-wgl", result)
     # never clobber a snapshot that belongs to a DIFFERENT check (the
     # mismatched-fingerprint case the load guard already ignores)
     if checkpoint is not None and _checkpoint_owned(checkpoint,

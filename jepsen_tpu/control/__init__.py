@@ -18,6 +18,7 @@ import contextlib
 import contextvars
 import logging
 
+from .. import obs
 from ..util import real_pmap
 from .core import (Lit, Remote, RemoteExecError, escape, lit,  # noqa: F401
                    throw_on_nonzero_exit)
@@ -86,7 +87,11 @@ def exec_star(*args, stdin=""):
                            "ssh_scope(test)")
     if _trace.get():
         logger.info("[%s] %s", _host.get(), cmd)
-    return sess.execute(_ctx(), {"cmd": cmd, "in": stdin})
+    t0 = obs.now_ns()
+    try:
+        return sess.execute(_ctx(), {"cmd": cmd, "in": stdin})
+    finally:
+        _record_remote("control.exec", t0, cmd=cmd)
 
 
 def exec_(*args, stdin=""):
@@ -97,14 +102,36 @@ def exec_(*args, stdin=""):
     return res.get("out", "").strip()
 
 
+def _record_remote(kind, t0, **args):
+    """One span + latency observation per remote call, on the issuing
+    host's track (every transport goes through these three chokepoints,
+    so SSH, Docker, k8s, and local runs all trace identically)."""
+    if not obs.enabled():
+        return
+    host = _host.get()
+    dur = obs.now_ns() - t0
+    obs.complete(kind, t0, dur, cat="control", host=str(host),
+                 **{k: str(v)[:200] for k, v in args.items()})
+    obs.observe("control.remote_s", dur / 1e9, op=kind.split(".")[-1])
+    obs.inc("control.remote_calls", op=kind.split(".")[-1])
+
+
 def upload(local_paths, remote_path):
     sess = _session.get()
-    return sess.upload(_ctx(), local_paths, remote_path)
+    t0 = obs.now_ns()
+    try:
+        return sess.upload(_ctx(), local_paths, remote_path)
+    finally:
+        _record_remote("control.upload", t0, remote_path=remote_path)
 
 
 def download(remote_paths, local_path):
     sess = _session.get()
-    return sess.download(_ctx(), remote_paths, local_path)
+    t0 = obs.now_ns()
+    try:
+        return sess.download(_ctx(), remote_paths, local_path)
+    finally:
+        _record_remote("control.download", t0, local_path=local_path)
 
 
 def upload_string(content, remote_path):

@@ -22,6 +22,7 @@ from . import control as c
 from . import db as jdb
 from . import history as jhistory
 from . import nemesis as jnemesis
+from . import obs
 from . import store
 from . import util
 from . import interpreter
@@ -93,11 +94,13 @@ def with_os(test):
     os_ = test.get("os")
     try:
         if os_ is not None:
-            c.on_nodes(test, os_.setup)
+            with obs.span("os.setup"):
+                c.on_nodes(test, os_.setup)
         yield
     finally:
         if os_ is not None:
-            c.on_nodes(test, os_.teardown)
+            with obs.span("os.teardown"):
+                c.on_nodes(test, os_.teardown)
 
 
 def snarf_logs(test):
@@ -106,6 +109,12 @@ def snarf_logs(test):
     db = test.get("db")
     if not isinstance(db, jdb.LogFiles) or not test.get("name"):
         return
+    with obs.span("snarf-logs"):
+        _snarf_logs(test)
+
+
+def _snarf_logs(test):
+    db = test["db"]
 
     def snarf(t, node):
         paths = db.log_files(t, node) or []
@@ -166,11 +175,13 @@ def with_db(test):
     try:
         with with_log_snarfing(test):
             if db is not None:
-                jdb.cycle(test)
+                with obs.span("db.cycle"):
+                    jdb.cycle(test)
             yield
     finally:
         if db is not None and not test.get("leave-db-running?"):
-            c.on_nodes(test, db.teardown)
+            with obs.span("db.teardown"):
+                c.on_nodes(test, db.teardown)
 
 
 @contextlib.contextmanager
@@ -190,38 +201,33 @@ def with_client_nemesis_setup_teardown(test):
         except Exception as e:  # noqa: BLE001
             nemesis_box["error"] = e
 
-    nf = threading.Thread(target=contextvars.copy_context().run,
-                          args=(setup_nemesis,),
-                          name="jepsen nemesis setup")
-    nf.start()
-
     def open_one(node):
         cl = jclient.validate(client).open(test, node)
         cl.setup(test)
         return cl
 
     clients = []
-    client_err = None
-    try:
-        clients = real_pmap(open_one, test.get("nodes") or [])
-    except Exception as e:  # noqa: BLE001
-        client_err = e
-    nf.join()
-    if "error" in nemesis_box:
-        raise nemesis_box["error"]
-    if client_err is not None:
-        raise client_err
-    test["nemesis"] = nemesis_box.get("nemesis", nemesis)
+    with obs.span("client-nemesis.setup"):
+        nf = threading.Thread(target=contextvars.copy_context().run,
+                              args=(setup_nemesis,),
+                              name="jepsen nemesis setup")
+        nf.start()
+        client_err = None
+        try:
+            clients = real_pmap(open_one, test.get("nodes") or [])
+        except Exception as e:  # noqa: BLE001
+            client_err = e
+        nf.join()
+        if "error" in nemesis_box:
+            raise nemesis_box["error"]
+        if client_err is not None:
+            raise client_err
+        test["nemesis"] = nemesis_box.get("nemesis", nemesis)
     try:
         yield
     finally:
         def teardown_nemesis():
             test["nemesis"].teardown(test)
-
-        nt = threading.Thread(target=contextvars.copy_context().run,
-                              args=(teardown_nemesis,),
-                              name="jepsen nemesis teardown")
-        nt.start()
 
         def close_one(cl):
             try:
@@ -229,24 +235,31 @@ def with_client_nemesis_setup_teardown(test):
             finally:
                 cl.close(test)
 
-        real_pmap(close_one, clients)
-        nt.join()
+        with obs.span("client-nemesis.teardown"):
+            nt = threading.Thread(target=contextvars.copy_context().run,
+                                  args=(teardown_nemesis,),
+                                  name="jepsen nemesis teardown")
+            nt.start()
+            real_pmap(close_one, clients)
+            nt.join()
 
 
 def run_case(test):
     """Spawns nemesis and clients, runs the generator, returns the history
     (core.clj:214-219)."""
     with with_client_nemesis_setup_teardown(test):
-        return interpreter.run(test)
+        with obs.span("run-case"):
+            return interpreter.run(test)
 
 
 def analyze(test):
     """Index the history, run the checker, save results
     (core.clj:221-236)."""
     logger.info("Analyzing...")
-    test["history"] = jhistory.index(test.get("history") or [])
-    test["results"] = jchecker.check_safe(
-        test.get("checker") or jchecker.noop(), test, test["history"])
+    with obs.span("analyze"):
+        test["history"] = jhistory.index(test.get("history") or [])
+        test["results"] = jchecker.check_safe(
+            test.get("checker") or jchecker.noop(), test, test["history"])
     logger.info("Analysis complete")
     if test.get("name"):
         store.save_2(test)
@@ -320,17 +333,33 @@ def run(test):
     db (+log snarfing) -> relative time -> run-case -> save-1 -> analyze
     (save-2) -> log-results."""
     test = prepare_test(test)
-    with with_logging(test):
-        with with_sessions(test):
-            with with_os(test):
-                with with_db(test):
-                    with util.ensure_relative_time():
-                        test["history"] = run_case(test)
-            # sessions still open: snarfing happened inside with_db
-        test.pop("barrier", None)
-        logger.info("Run complete, writing")
-        if test.get("name"):
-            store.save_1(test)
-        analyze(test)
-        log_results(test)
+    with obs.run_scope(test):
+        try:
+            with with_logging(test):
+                with obs.span("jepsen.run",
+                              test_name=str(test.get("name"))):
+                    with with_sessions(test):
+                        with with_os(test):
+                            with with_db(test):
+                                with util.ensure_relative_time():
+                                    test["history"] = run_case(test)
+                        # sessions still open: snarfing happened inside
+                        # with_db
+                    test.pop("barrier", None)
+                    logger.info("Run complete, writing")
+                    if test.get("name"):
+                        store.save_1(test)
+                    analyze(test)
+                log_results(test)
+        finally:
+            # persist the artifacts in a finally: a CRASHED run is
+            # exactly the one whose trace matters, and by now every
+            # span (including jepsen.run) has closed through the
+            # unwinding context managers (write_obs logs rather than
+            # raises, so it cannot mask the run's own exception). Then
+            # drop the handles — the tracer buffer can hold up to 1M
+            # event dicts, which a retained test map must not pin.
+            if test.get("name") and test.get("obs"):
+                store.write_obs(test)
+            test.pop("obs", None)
     return test

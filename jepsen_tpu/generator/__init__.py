@@ -32,6 +32,7 @@ import logging
 import random as _random
 from dataclasses import dataclass, field, replace
 
+from .. import obs
 from ..util import secs_to_nanos
 
 logger = logging.getLogger(__name__)
@@ -307,7 +308,10 @@ class FriendlyExceptions(Generator):
 
 @dataclass(frozen=True)
 class Trace(Generator):
-    """Logs ops and updates with a tag (generator.clj:720-762)."""
+    """Logs ops and updates with a tag (generator.clj:720-762), and
+    routes the same stream through the obs tracer when one is bound —
+    one unified event stream, not a second ad-hoc one (the log lines
+    stay for grep parity with the reference)."""
 
     k: object
     gen: object
@@ -316,6 +320,7 @@ class Trace(Generator):
         res = gen_op(self.gen, test, ctx)
         logger.info("%s op -> %r", self.k,
                     res[0] if res else None)
+        obs.gen_event(self.k, "op", res[0] if res else None)
         if res is None:
             return None
         op, gen2 = res
@@ -323,6 +328,7 @@ class Trace(Generator):
 
     def update(self, test, ctx, event):
         logger.info("%s update <- %r", self.k, event)
+        obs.gen_event(self.k, "update", event)
         return Trace(self.k, gen_update(self.gen, test, ctx, event))
 
 

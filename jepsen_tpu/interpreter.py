@@ -18,6 +18,7 @@ import threading
 import time as _time
 
 from . import client as jclient
+from . import obs
 from . import util
 from . import generator as gen
 
@@ -152,6 +153,12 @@ def run(test):
         return _run(test)
 
 
+def _trace_tid(thread):
+    """Logical worker -> Chrome-trace tid: client workers keep their
+    integer ids; the nemesis gets -1 (trace tids must be numeric)."""
+    return thread if isinstance(thread, int) else -1
+
+
 def _run(test):
     ctx = gen.context(test)
     worker_ids = ctx.all_threads()
@@ -160,10 +167,16 @@ def _run(test):
                for wid in worker_ids]
     inboxes = {w["id"]: w["inbox"] for w in workers}
     g = gen.validate(gen.friendly_exceptions(test.get("generator")))
+    if obs.enabled():
+        for wid in worker_ids:
+            obs.name_thread(_trace_tid(wid), f"worker {wid}")
 
     outstanding = 0
     poll_timeout = 0.0   # seconds
     history = []
+    # per-thread invoke timestamps (tracer clock) for the invoke->
+    # complete op spans; at most one op is outstanding per thread
+    inflight = {}
     try:
         while True:
             op2 = None
@@ -181,6 +194,21 @@ def _run(test):
                 op2 = dict(op2)
                 op2["time"] = now
                 ctx = ctx.with_time(now).free(thread)
+                if obs.enabled():
+                    start = inflight.pop(thread, None)
+                    if start is not None:
+                        t1 = obs.now_ns()
+                        obs.complete(
+                            f"{op2.get('f')}", start, t1 - start,
+                            cat="op", tid=_trace_tid(thread),
+                            process=op2.get("process"),
+                            type=op2.get("type"))
+                        obs.observe("interpreter.op_latency_s",
+                                    (t1 - start) / 1e9)
+                    if goes_in_history(op2):
+                        obs.inc("interpreter.ops_completed",
+                                type=str(op2.get("type")),
+                                f=str(op2.get("f")))
                 g = gen.gen_update(g, test, ctx, op2)
                 if thread != gen.NEMESIS and op2.get("type") == "info":
                     ctx = ctx.with_worker(thread, ctx.next_process(thread))
@@ -219,6 +247,9 @@ def _run(test):
 
             thread = ctx.process_to_thread(op["process"])
             inboxes[thread].put(op)
+            if obs.enabled() and op.get("type") == "invoke":
+                inflight[thread] = obs.now_ns()
+                obs.inc("interpreter.ops_invoked", f=str(op.get("f")))
             ctx = ctx.with_time(op["time"]).busy(thread)
             g = gen.gen_update(g2, test, ctx, op)
             if goes_in_history(op):

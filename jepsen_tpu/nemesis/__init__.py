@@ -18,6 +18,7 @@ from ._grudges import (bisect, bridge, complete_grudge,  # noqa: F401
                        split_one)
 from .. import control as c
 from .. import net as net_
+from .. import obs
 from ..util import timeout_call
 
 
@@ -66,7 +67,9 @@ class Validate(Nemesis):
         return Validate(res)
 
     def invoke(self, test, op):
+        t0 = obs.now_ns()
         out = self.nemesis.invoke(test, op)
+        _record_fault(op, out, t0)
         problems = []
         if not isinstance(out, dict):
             problems.append("should be a dict")
@@ -92,6 +95,29 @@ class Validate(Nemesis):
 
 def validate(nemesis):
     return Validate(nemesis)
+
+
+def _record_fault(op, out, t0):
+    """Trace one nemesis invocation (every nemesis in a run is wrapped
+    by Validate, so this sees them all): an ``X`` span on the nemesis
+    track for the invocation itself, plus an async fault *window* —
+    ``start*`` fs open it, the matching ``stop*`` f closes it — so the
+    whole disruption interval is visible in Perfetto even though the
+    start and stop run as separate ops."""
+    if not obs.enabled():
+        return
+    f = str(op.get("f"))
+    obs.complete(f"nemesis.{f}", t0, obs.now_ns() - t0, cat="nemesis",
+                 tid=-1, value=repr(out.get("value"))[:200]
+                 if isinstance(out, dict) else None)
+    obs.inc("nemesis.ops", f=f)
+    if f.startswith("start"):
+        obs.window_start("fault", f[len("start"):].strip("-_") or "fault",
+                         f=f)
+        obs.inc("nemesis.faults_started")
+    elif f.startswith("stop"):
+        obs.window_end("fault", f[len("stop"):].strip("-_") or "fault",
+                       f=f)
 
 
 class Timeout(Nemesis):

@@ -1,0 +1,183 @@
+"""Unified observability: tracing + metrics for the whole stack.
+
+One module gives every layer (control → interpreter → nemesis →
+checker → store → web → bench, plus the device WGL search) the same two
+primitives:
+
+* a span-based **tracer** (`trace.Tracer`) emitting Chrome-trace /
+  Perfetto-compatible ``trace.jsonl``;
+* a **metrics registry** (`metrics.Registry`) of counters, gauges, and
+  latency histograms serialized to ``metrics.json``.
+
+Binding is a module-global pair set by `bind()` — *not* a contextvar —
+because instrumented code runs on threads the binder never created
+(interpreter workers, checker-competition racers, web handlers); all of
+them must see the active sinks. Span *nesting* still flows through a
+contextvar (trace._span_stack), so parentage follows the
+`contextvars.copy_context()` snapshots the thread fan-outs already
+take.
+
+Every facade function below is a safe no-op while nothing is bound:
+off-by-default, one global read + falsy check per call site, so the
+uninstrumented hot paths pay nothing measurable. `core.run` binds a
+fresh pair per test run (opt out with ``test["obs?"] = False``) and
+store.py persists both artifacts next to ``results.json``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time as _time
+
+from .metrics import DEFAULT_LATENCY_BUCKETS_S, Histogram, Registry
+from .trace import Tracer, current_span, load_trace
+
+__all__ = [
+    "Tracer", "Registry", "Histogram", "DEFAULT_LATENCY_BUCKETS_S",
+    "bind", "run_scope", "tracer", "registry", "enabled", "current_span",
+    "load_trace", "span", "instant", "complete", "counter_track",
+    "window_start", "window_end", "name_thread", "now_ns", "inc",
+    "set_gauge", "max_gauge", "observe", "gen_event",
+]
+
+_lock = threading.Lock()
+_tracer = None
+_registry = None
+
+
+def tracer():
+    """The active Tracer, or None."""
+    return _tracer
+
+
+def registry():
+    """The active Registry, or None."""
+    return _registry
+
+
+def enabled():
+    return _tracer is not None or _registry is not None
+
+
+@contextlib.contextmanager
+def bind(tr=None, reg=None):
+    """Install (tracer, registry) as the process-wide sinks for the
+    duration. Re-entrant for same-thread nesting: the previous pair is
+    restored on exit. Like store's per-test log handler, the binding
+    assumes one test run at a time per process — two OVERLAPPING
+    core.runs on different threads would restore out of order and
+    cross-attribute telemetry (harmless to the runs themselves)."""
+    global _tracer, _registry
+    with _lock:
+        prev = (_tracer, _registry)
+        _tracer, _registry = tr, reg
+    try:
+        yield (tr, reg)
+    finally:
+        with _lock:
+            _tracer, _registry = prev
+
+
+def run_scope(test):
+    """The per-test-run binding `core.run` uses: creates a fresh tracer
+    + registry (unless ``test["obs?"]`` is falsy), parks them in
+    ``test["obs"]`` so store.write_obs can persist them, and binds them
+    for the run's duration."""
+    if not test.get("obs?", True):
+        test.pop("obs", None)
+        return contextlib.nullcontext((None, None))
+    tr, reg = Tracer(), Registry()
+    test["obs"] = {"tracer": tr, "registry": reg}
+    return bind(tr, reg)
+
+
+# ---------------------------------------------------------------------------
+# tracing facade (no-ops while unbound)
+
+def now_ns():
+    tr = _tracer
+    return tr.now_ns() if tr is not None else _time.monotonic_ns()
+
+
+def span(name, cat="lifecycle", tid=None, **args):
+    """Context manager: a nested trace span (no-op while unbound)."""
+    tr = _tracer
+    if tr is None:
+        return contextlib.nullcontext()
+    return tr.span(name, cat=cat, tid=tid, args=args or None)
+
+
+def instant(name, cat="default", tid=None, **args):
+    tr = _tracer
+    if tr is not None:
+        tr.instant(name, cat=cat, tid=tid, args=args or None)
+
+
+def complete(name, ts_ns, dur_ns, cat="default", tid=None, **args):
+    tr = _tracer
+    if tr is not None:
+        tr.complete(name, ts_ns, dur_ns, cat=cat, tid=tid,
+                    args=args or None)
+
+
+def counter_track(name, cat="default", **values):
+    tr = _tracer
+    if tr is not None:
+        tr.counter(name, values, cat=cat)
+
+
+def window_start(name, wid, cat="nemesis", **args):
+    tr = _tracer
+    if tr is not None:
+        tr.async_begin(name, wid, cat=cat, args=args or None)
+
+
+def window_end(name, wid, cat="nemesis", **args):
+    tr = _tracer
+    if tr is not None:
+        tr.async_end(name, wid, cat=cat, args=args or None)
+
+
+def name_thread(tid, name):
+    tr = _tracer
+    if tr is not None:
+        tr.name_thread(tid, name)
+
+
+def gen_event(tag, kind, payload):
+    """The generator.trace combinator's tap: one instant event per
+    traced op/update, alongside its existing log line. The repr is
+    capped like every other instrumentation site — traced generators
+    over large values must not bloat the event buffer."""
+    tr = _tracer
+    if tr is not None:
+        tr.instant(f"gen.{tag}", cat="generator",
+                   args={"kind": kind, "event": repr(payload)[:200]})
+
+
+# ---------------------------------------------------------------------------
+# metrics facade (no-ops while unbound)
+
+def inc(name, n=1, **labels):
+    reg = _registry
+    if reg is not None:
+        reg.inc(name, n, **labels)
+
+
+def set_gauge(name, value, **labels):
+    reg = _registry
+    if reg is not None:
+        reg.set_gauge(name, value, **labels)
+
+
+def max_gauge(name, value, **labels):
+    reg = _registry
+    if reg is not None:
+        reg.max_gauge(name, value, **labels)
+
+
+def observe(name, value, buckets=None, **labels):
+    reg = _registry
+    if reg is not None:
+        reg.observe(name, value, buckets=buckets, **labels)

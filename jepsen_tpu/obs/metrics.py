@@ -1,0 +1,140 @@
+"""Metrics registry: counters, gauges, and fixed-bucket histograms.
+
+Prometheus-shaped but dependency-free: a `Registry` holds named series
+(with optional labels), and `snapshot()` renders everything to one
+plain-JSON dict — the ``metrics.json`` artifact store.py writes next to
+``results.json``. All mutation is lock-protected; instrumented hot
+paths (one op completion = one counter bump + one histogram observe)
+stay cheap.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+#: fixed latency buckets, seconds: ~log-spaced from 100 µs to 2 min.
+#: Counts are PER-BUCKET (not cumulative); values above the last bound
+#: land in one overflow bucket, so len(counts) == len(bounds) + 1.
+DEFAULT_LATENCY_BUCKETS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+def bucket_index(bounds, value):
+    """Index of the bucket ``value`` falls in: first i with value <=
+    bounds[i], else len(bounds) (the overflow bucket)."""
+    for i, b in enumerate(bounds):
+        if value <= b:
+            return i
+    return len(bounds)
+
+
+class Histogram:
+    """Fixed-bucket histogram with sum/count/min/max."""
+
+    def __init__(self, bounds=DEFAULT_LATENCY_BUCKETS_S):
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value):
+        value = float(value)
+        self.counts[bucket_index(self.bounds, value)] += 1
+        self.sum += value
+        self.count += 1
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+
+    def quantile(self, q):
+        """Estimated q-quantile (0..1) by linear walk over the buckets;
+        None when empty. Values in the overflow bucket report the max."""
+        if self.count == 0:
+            return None
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target and c:
+                if i == len(self.bounds):
+                    return self.max
+                return self.bounds[i]
+        return self.max
+
+    def to_dict(self):
+        return {"buckets_le": list(self.bounds),
+                "counts": list(self.counts),
+                "sum": self.sum, "count": self.count,
+                "min": None if self.count == 0 else self.min,
+                "max": None if self.count == 0 else self.max}
+
+
+def _key(name, labels):
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Registry:
+    """Thread-safe home for counters, gauges, and histograms."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+        self._histograms = {}
+
+    def inc(self, name, n=1, **labels):
+        k = _key(name, labels)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0) + n
+
+    def set_gauge(self, name, value, **labels):
+        k = _key(name, labels)
+        with self._lock:
+            self._gauges[k] = value
+
+    def max_gauge(self, name, value, **labels):
+        """Set a gauge to max(current, value) — high-water marks."""
+        k = _key(name, labels)
+        with self._lock:
+            cur = self._gauges.get(k)
+            if cur is None or value > cur:
+                self._gauges[k] = value
+
+    def observe(self, name, value, buckets=None, **labels):
+        k = _key(name, labels)
+        with self._lock:
+            hist = self._histograms.get(k)
+            if hist is None:
+                hist = self._histograms[k] = Histogram(
+                    buckets or DEFAULT_LATENCY_BUCKETS_S)
+            hist.observe(value)
+
+    def histogram(self, name, **labels):
+        with self._lock:
+            return self._histograms.get(_key(name, labels))
+
+    def counter_value(self, name, **labels):
+        with self._lock:
+            return self._counters.get(_key(name, labels), 0)
+
+    def gauge_value(self, name, **labels):
+        with self._lock:
+            return self._gauges.get(_key(name, labels))
+
+    def snapshot(self):
+        """One plain-JSON dict of everything: the metrics.json payload."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.to_dict()
+                               for k, h in self._histograms.items()},
+            }

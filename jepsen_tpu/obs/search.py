@@ -1,0 +1,159 @@
+"""Search telemetry for the device WGL engines.
+
+A long linearizability search used to be a silent multi-minute `jit`
+black box: the host loop dispatched bounded chunks and nothing was
+observable until the verdict (or a watchdog kill). These helpers give
+the three host loops — the single-key search (checker/jax_wgl.py), the
+multi-key batch (parallel/keyshard.py), and the mesh-sharded single
+search (parallel/searchshard.py) — one cheap call per dispatch:
+
+* `heartbeat()` emits an instant trace event + counter tracks (frontier
+  depth, states explored, keys still running, shard balance) and
+  updates gauges, so a stalled search is diagnosable mid-flight from
+  trace.jsonl;
+* `summary()` records the final verdict's telemetry (states explored,
+  chunk count, iteration count, dedup-table load / insert failures,
+  per-shard work split) into the metrics registry.
+
+Engines call `capture()` ONCE at search entry and use the returned
+session for every emission. The session pins the tracer/registry that
+were bound when the search STARTED: the checker competition abandons
+losing engine threads after a 0.5 s join (they may still be mid
+device-compile), and a straggler reading the process-global sinks per
+call would write phantom heartbeats into the NEXT run's artifacts.
+With captured sinks a straggler keeps streaming into its own (already
+discarded) buffers — harmless.
+
+Everything no-ops while obs is unbound, so the engines pay one global
+read per search plus cheap None checks per dispatched chunk when
+tracing is off — the loops' own device syncs dominate by orders of
+magnitude.
+"""
+
+from __future__ import annotations
+
+from . import registry, tracer
+
+__all__ = ["capture", "enabled", "SearchObs"]
+
+#: wall-time buckets for per-chunk dispatch latency: chunks target
+#: ~1-3 s; the tail buckets catch TPU-tunnel stalls (observed: single
+#: dispatches of 100+ s)
+CHUNK_BUCKETS_S = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0, 120.0, 300.0, 600.0)
+
+
+def enabled():
+    """Whether obs sinks are currently bound (for gating extra host
+    work like device reads before a `capture()`d session exists)."""
+    return tracer() is not None or registry() is not None
+
+
+def capture():
+    """Snapshot the currently bound sinks into a search session."""
+    return SearchObs(tracer(), registry())
+
+
+class SearchObs:
+    """One search's telemetry channel, pinned to the sinks bound at
+    search start (see module docstring for why not per-call globals)."""
+
+    def __init__(self, tr, reg):
+        self._tr = tr
+        self._reg = reg
+
+    def enabled(self):
+        return self._tr is not None or self._reg is not None
+
+    def heartbeat(self, engine, iteration, chunk_s, frontier=None,
+                  explored=None, keys_alive=None, keys_running=None,
+                  compactions=None, shard_tops=None, **extra):
+        """One call per host→device dispatch. ``frontier`` is the DFS
+        stack depth (scalar, or summed over keys), ``explored`` the
+        cumulative states-explored counter, ``shard_tops`` the
+        per-shard frontier sizes (the steal-ring balance signal)."""
+        tr, reg = self._tr, self._reg
+        if tr is None and reg is None:
+            return
+        if reg is not None:
+            reg.inc("wgl.chunks", engine=engine)
+            reg.observe("wgl.chunk_s", chunk_s,
+                        buckets=CHUNK_BUCKETS_S, engine=engine)
+        fields = {"iteration": iteration, "chunk_s": round(chunk_s, 4)}
+        track = {}
+        if frontier is not None:
+            fields["frontier"] = track["frontier"] = int(frontier)
+            if reg is not None:
+                reg.set_gauge("wgl.frontier_depth", int(frontier),
+                              engine=engine)
+                reg.max_gauge("wgl.frontier_depth_max", int(frontier),
+                              engine=engine)
+        if explored is not None:
+            fields["explored"] = track["explored"] = int(explored)
+            if reg is not None:
+                reg.set_gauge("wgl.states_explored", int(explored),
+                              engine=engine)
+        if keys_alive is not None:
+            fields["keys_alive"] = int(keys_alive)
+        if keys_running is not None:
+            fields["keys_running"] = track["keys_running"] = \
+                int(keys_running)
+            if reg is not None:
+                reg.set_gauge("wgl.keys_running", int(keys_running),
+                              engine=engine)
+        if compactions is not None:
+            fields["compactions"] = int(compactions)
+        if shard_tops is not None:
+            tops = [int(t) for t in shard_tops]
+            fields["shard_tops"] = tops
+            busy = sum(1 for t in tops if t > 0)
+            fields["shards_with_work"] = track["shards_with_work"] = busy
+            if reg is not None:
+                reg.set_gauge("wgl.shards_with_work", busy,
+                              engine=engine)
+        fields.update(extra)
+        if tr is not None:
+            tr.instant(f"wgl.heartbeat.{engine}", cat="search",
+                       args=fields)
+            if track:
+                tr.counter(f"wgl.{engine}", track, cat="search")
+
+    def summary(self, engine, result, keys=None, shard_explored=None):
+        """Record a finished search's telemetry from its result dict."""
+        tr, reg = self._tr, self._reg
+        if tr is None and reg is None:
+            return
+        verdict = result.get("valid")
+        if reg is not None:
+            reg.inc("wgl.searches", engine=engine)
+            reg.inc("wgl.verdicts", engine=engine, valid=str(verdict))
+            if result.get("configs_explored") is not None:
+                reg.inc("wgl.states_explored_total",
+                        int(result["configs_explored"]), engine=engine)
+            if result.get("iterations") is not None:
+                reg.inc("wgl.iterations_total",
+                        int(result["iterations"]), engine=engine)
+            if result.get("table_load") is not None:
+                reg.set_gauge("wgl.table_load", result["table_load"],
+                              engine=engine)
+            if result.get("table_insert_failures") is not None:
+                reg.inc("wgl.table_insert_failures",
+                        int(result["table_insert_failures"]),
+                        engine=engine)
+        if tr is not None:
+            fields = {k: result.get(k) for k in
+                      ("valid", "configs_explored", "iterations",
+                       "engine", "table_load", "table_insert_failures",
+                       "error")
+                      if result.get(k) is not None}
+            if keys is not None:
+                fields["keys"] = int(keys)
+            if shard_explored is not None:
+                fields["shard_explored"] = [int(x)
+                                            for x in shard_explored]
+                # work-split imbalance: max shard share of the total
+                total = sum(fields["shard_explored"]) or 1
+                fields["shard_max_share"] = round(
+                    max(fields["shard_explored"]) / total, 4)
+            fields["valid"] = str(verdict)
+            tr.instant(f"wgl.done.{engine}", cat="search", args=fields)

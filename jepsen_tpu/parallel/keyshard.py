@@ -38,8 +38,25 @@ from ..checker.jax_wgl import (IDX_BEST_DEPTH, IDX_BEST_LIN,
                                _encode_arrays, _plan_sizes,
                                max_point_concurrency, table_stats)
 from ..history import INF_TIME
+from ..obs import search as obs_search
 
 logger = logging.getLogger(__name__)
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """The one place both mesh paths build their shard_map. These
+    kernels must disable the replication check (check_vma=False: the
+    steal-ring collectives aren't replicated). Deliberately NO fallback
+    to the older check_rep spelling: jax 0.4.x's check_rep=False path
+    SEGFAULTS the whole test process on these donated-carry while_loop
+    kernels (measured here on 0.4.37) — a clean TypeError on old jax
+    beats taking the interpreter down."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.4.35 layout
+        from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)
 
 
 def _pad_key(e, init_state, spec, n_pad, S_pad, A, enc=None):
@@ -219,10 +236,6 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
                                   NS=rollout_seeds,
                                   rollout_kernel="scan")
             return rb
-        try:
-            from jax import shard_map
-        except ImportError:  # older jax
-            from jax.experimental.shard_map import shard_map
         carry_specs, const_specs = _shard_specs(mesh)
         # the kernel run under shard_map sees LOCAL shapes: Kc/G keys
         # and one table group per device
@@ -230,10 +243,9 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
                                      S_pad, C, A, Wc, O, T, 1,
                                      R=R_batch, NS=rollout_seeds,
                                      rollout_kernel="scan")
-        return jax.jit(shard_map(
-            run_local.__wrapped__, mesh=mesh,
-            in_specs=(carry_specs,) + const_specs,
-            out_specs=carry_specs, check_vma=False),
+        return jax.jit(shard_map_compat(
+            run_local.__wrapped__, mesh,
+            (carry_specs,) + const_specs, carry_specs),
             donate_argnums=(0,))
 
     def wide_W(Kc):
@@ -303,6 +315,8 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     last_ckpt = t0
     timed_out = False
     n_compactions = 0
+    # sinks captured once at search start (see obs.search docstring)
+    so = obs_search.capture()
     # adaptive dispatch quantum (jax_wgl._adapt_quantum, shared with
     # the single-key loop): calibrated from the measured per-iteration
     # wall. The batch targets ~1 s per dispatch (shorter than the
@@ -355,6 +369,16 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         its = np.asarray(carry[IDX_ITS])
         running = (status == RUNNING) & (top > 0) & (its < max_iters)
         n_run = int(running.sum())
+        # heartbeat from arrays this poll already fetched — explored is
+        # deliberately NOT read per chunk (one extra device_get per
+        # dispatch costs ~0.2 s over the remote tunnel, enough to dent
+        # the benched batch rates); the summary reports it from harvest
+        so.heartbeat(
+            "jax-wgl-batch", iteration=it,
+            chunk_s=_time.monotonic() - t_chunk,
+            frontier=int(top.sum()),
+            keys_alive=len(alive), keys_running=n_run,
+            compactions=n_compactions)
         if n_run == 0:
             harvest(range(len(alive)), carry)
             break
@@ -437,6 +461,17 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         # batch-wide diagnostic: how often stragglers were compacted
         # (and, under a mesh, resharded) during this run
         results[k]["compactions"] = n_compactions
+    if so.enabled():
+        so.summary(
+            "jax-wgl-batch",
+            {"valid": "batch",
+             "configs_explored": sum(
+                 int(h["explored"]) for h in harvested.values()),
+             "iterations": max(
+                 (int(h["iterations"]) for h in harvested.values()),
+                 default=0),
+             **tstats},
+            keys=len(live))
     return results
 
 

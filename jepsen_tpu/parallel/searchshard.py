@@ -57,7 +57,8 @@ from ..checker.jax_wgl import (IDX_BEST_DEPTH, IDX_BEST_LIN,
                                IDX_BEST_STATE, IDX_DROPPED, IDX_EXPLORED,
                                IDX_IT, IDX_ITS, IDX_STATUS, IDX_TOP,
                                RUNNING, VALID, _build_search, _plan_sizes)
-from .keyshard import _shard_specs
+from ..obs import search as obs_search
+from .keyshard import _shard_specs, shard_map_compat
 
 logger = logging.getLogger(__name__)
 
@@ -90,15 +91,10 @@ def check_encoded_sharded(spec, e, init_state, mesh,
                                  T, 1, NS=rollout_seeds,
                                  rollout_kernel="scan", axis_name=ax,
                                  axis_size=D, steal=steal)
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
     carry_specs, const_specs = _shard_specs(mesh)
-    run_b = jax.jit(shard_map(
-        run_local.__wrapped__, mesh=mesh,
-        in_specs=(carry_specs,) + const_specs,
-        out_specs=carry_specs, check_vma=False),
+    run_b = jax.jit(shard_map_compat(
+        run_local.__wrapped__, mesh,
+        (carry_specs,) + const_specs, carry_specs),
         donate_argnums=(0,))
 
     # global init: the builder's init_carry for K=D shards, then only
@@ -125,6 +121,8 @@ def check_encoded_sharded(spec, e, init_state, mesh,
 
     t0 = _time.monotonic()
     timed_out = False
+    # sinks captured once at search start (see obs.search docstring)
+    so = obs_search.capture()
     it = 0
     eff = min(chunk_iters, 32, max(1, (32 * 16384) // n_pad))
     while True:
@@ -135,6 +133,15 @@ def check_encoded_sharded(spec, e, init_state, mesh,
         status = np.asarray(carry[IDX_STATUS])
         top = np.asarray(carry[IDX_TOP])
         it = int(np.asarray(carry[IDX_IT])[0])
+        # per-shard frontier sizes ARE the steal-ring balance signal:
+        # all work stuck on one shard = the ring is starved. Built from
+        # the arrays this poll already fetched (explored waits for the
+        # summary — no extra per-chunk device reads)
+        so.heartbeat(
+            "jax-wgl-sharded", iteration=it,
+            chunk_s=_time.monotonic() - t_chunk,
+            frontier=int(top.sum()),
+            shard_tops=[int(t) for t in top])
         if (status == VALID).any() or not ((status == RUNNING)
                                            & (top > 0)).any() \
                 or it >= max_iters:
@@ -164,12 +171,18 @@ def check_encoded_sharded(spec, e, init_state, mesh,
               "engine": "jax-wgl-sharded", "shards": D,
               "shard_explored": [int(x) for x in explored],
               **tstats}
+
+    def _done(result):
+        so.summary("jax-wgl-sharded", result,
+                   shard_explored=result["shard_explored"])
+        return result
+
     if (status == VALID).any():
         result["valid"] = True
-        return result
+        return _done(result)
     if timed_out and ((status == RUNNING) & (top > 0)).any():
         result.update(valid="unknown", error="timeout")
-        return result
+        return _done(result)
     # an empty-everywhere, nothing-dropped state is a sound exhaustion
     # proof no matter when it was reached (even on the last allowed
     # iteration -- the single-device _interpret has no it guard either)
@@ -188,11 +201,11 @@ def check_encoded_sharded(spec, e, init_state, mesh,
                   .reshape(D * jax_wgl.TOPK, -1)}
         jax_wgl._attach_witness(result, e, merged, perm, spec,
                                 init_state)
-        return result
+        return _done(result)
     result.update(valid="unknown",
                   error="stack-overflow" if dropped
                   else "max-configs-exceeded")
-    return result
+    return _done(result)
 
 
 def check_history_sharded(spec, history, mesh, **kw):

@@ -21,6 +21,7 @@ import json
 import logging
 import os
 import os.path
+import pathlib
 import shutil
 
 from . import history as h
@@ -35,7 +36,7 @@ base_dir = "store"
 #: (store.clj:160-162).
 DEFAULT_NONSERIALIZABLE_KEYS = {
     "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
-    "remote", "barrier", "sessions", "dummy-log",
+    "remote", "barrier", "sessions", "dummy-log", "obs",
 }
 
 TIME_FORMAT = "%Y%m%dT%H%M%S.%f%z"
@@ -99,14 +100,17 @@ class _Encoder(json.JSONEncoder):
             return o.isoformat()
         if isinstance(o, bytes):
             return o.decode("utf-8", errors="replace")
+        if isinstance(o, pathlib.PurePath):
+            return str(o)
         try:
             import numpy as np
-            if isinstance(o, np.integer):
-                return int(o)
-            if isinstance(o, np.floating):
-                return float(o)
             if isinstance(o, np.ndarray):
                 return o.tolist()
+            if isinstance(o, np.generic):
+                # every numpy scalar -- int, float, AND bool_ (which
+                # repr'd as "True" strings before and broke metrics
+                # snapshots round-tripping through JSON)
+                return o.item()
         except ImportError:  # pragma: no cover
             pass
         return repr(o)
@@ -177,18 +181,46 @@ def update_symlinks(test):
         update_symlink(test, dest)
 
 
+def write_obs(test):
+    """Writes the observability artifacts next to results.json:
+    ``trace.jsonl`` (Chrome-trace/Perfetto span stream) and
+    ``metrics.json`` (the registry snapshot). The handles live under
+    test["obs"] (set by obs.run_scope; nonserializable).
+
+    Failures are logged, never raised: telemetry is a byproduct, and a
+    disk-full trace dump inside save_1 must not abort the run before
+    analysis writes results.json."""
+    o = test.get("obs") or {}
+    tracer = o.get("tracer")
+    registry = o.get("registry")
+    try:
+        if tracer is not None:
+            tracer.dump(make_path(test, "trace.jsonl"))
+        if registry is not None:
+            _dump_json(registry.snapshot(),
+                       make_path(test, "metrics.json"))
+    except Exception:  # noqa: BLE001
+        logger.warning("couldn't write obs artifacts", exc_info=True)
+
+
 def save_1(test):
     """Phase 1: history + test map, right after the run and before analysis
     (store.clj:388-399). Returns test."""
     write_history(test)
     write_test(test)
+    write_obs(test)
     update_symlinks(test)
     return test
 
 
 def save_2(test):
     """Phase 2: after computing results, re-write everything plus
-    results.json (store.clj:401-413). Returns test."""
+    results.json (store.clj:401-413). Returns test.
+
+    Deliberately no write_obs here: save_1 already wrote the
+    crash-insurance copy, and core.run re-dumps the final artifacts
+    once the root span closes moments after save_2 — serializing a
+    potentially huge event buffer twice back-to-back buys nothing."""
     write_results(test)
     write_history(test)
     write_test(test)

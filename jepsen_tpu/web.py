@@ -42,7 +42,8 @@ def _valid_class(valid):
 
 
 def _fast_tests():
-    """Test rows from results.json headers only (web.clj:48-69)."""
+    """Test rows from results.json headers only (web.clj:48-69), plus
+    which observability artifacts each run has on disk."""
     rows = []
     for name in store.test_names():
         for t in sorted(store.tests(name), reverse=True):
@@ -52,7 +53,11 @@ def _fast_tests():
                 valid = r.get("valid") if isinstance(r, dict) else None
             except (FileNotFoundError, json.JSONDecodeError):
                 valid = "incomplete"
-            rows.append({"name": name, "time": t, "valid": valid})
+            fake = {"name": name, "start-time": t}
+            obs_files = [f for f in ("trace.jsonl", "metrics.json")
+                         if os.path.exists(store.path(fake, f))]
+            rows.append({"name": name, "time": t, "valid": valid,
+                         "obs": obs_files})
     rows.sort(key=lambda r: r["time"], reverse=True)
     return rows
 
@@ -63,16 +68,21 @@ def _home_page():
         link = f"/files/{urllib.parse.quote(t['name'])}/" \
                f"{urllib.parse.quote(t['time'])}/"
         zip_link = link.rstrip("/") + ".zip"
+        obs_links = " ".join(
+            f'<a href="{link}{f}">{html.escape(f.split(".")[0])}</a>'
+            for f in t.get("obs", ()))
         rows.append(
             f'<tr class="{_valid_class(t["valid"])}">'
             f'<td>{html.escape(t["name"])}</td>'
             f'<td><a href="{link}">{html.escape(t["time"])}</a></td>'
             f'<td>{html.escape(str(t["valid"]))}</td>'
+            f'<td>{obs_links}</td>'
             f'<td><a href="{zip_link}">zip</a></td></tr>')
     return f"""<html><head><style>{STYLE}</style>
 <title>Jepsen</title></head><body>
 <h1>Jepsen</h1>
-<table><thead><tr><th>Test</th><th>Time</th><th>Valid?</th><th></th>
+<table><thead><tr><th>Test</th><th>Time</th><th>Valid?</th>
+<th>Observability</th><th></th>
 </tr></thead><tbody>{''.join(rows)}</tbody></table></body></html>"""
 
 

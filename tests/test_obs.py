@@ -1,0 +1,446 @@
+"""The unified observability layer: tracer, metrics, persistence, and
+the instrumentation wired through core/interpreter/nemesis/control/
+checker and the device WGL search."""
+
+import contextvars
+import json
+import pathlib
+import random
+import threading
+
+import pytest
+
+from jepsen_tpu import core, generator as gen, obs, store
+from jepsen_tpu import tests as tst
+from jepsen_tpu.generator import testing as gtest
+from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.tests import Atom
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+
+
+def dummy_test(**kw):
+    t = tst.noop_test()
+    t["ssh"] = {"dummy?": True}
+    t.update(kw)
+    return t
+
+
+# ---------------------------------------------------------------------------
+# tracer
+
+def test_off_by_default():
+    """No sinks bound -> every facade call is a no-op (the <5%-or-off
+    acceptance criterion: instrumented hot paths pay one global read)."""
+    assert not obs.enabled()
+    assert obs.tracer() is None and obs.registry() is None
+    # none of these may raise or record anything
+    with obs.span("x"):
+        obs.instant("i")
+        obs.complete("c", 0, 10)
+        obs.inc("n")
+        obs.observe("h", 0.1)
+    assert not obs.enabled()
+
+
+def test_span_nesting_and_thread_propagation():
+    """Span parentage flows through contextvars snapshots -- the same
+    mechanism the interpreter's worker spawn uses -- so a span opened on
+    a worker thread records the spawning scope's span as its parent."""
+    tr = obs.Tracer()
+    inner_parent = {}
+    with obs.bind(tr, None):
+        with tr.span("outer"):
+            assert obs.current_span() == "outer"
+            ctx = contextvars.copy_context()
+
+            def worker():
+                inner_parent["before"] = obs.current_span()
+                with tr.span("inner"):
+                    pass
+
+            t = threading.Thread(target=ctx.run, args=(worker,))
+            t.start()
+            t.join()
+    evs = {e["name"]: e for e in tr.events() if e["ph"] == "X"}
+    assert inner_parent["before"] == "outer"
+    assert evs["inner"]["args"]["parent"] == "outer"
+    assert "parent" not in (evs["outer"].get("args") or {})
+    # the inner span ran on a different OS thread: distinct tids
+    assert evs["inner"]["tid"] != evs["outer"]["tid"]
+
+
+def test_trace_dump_is_chrome_trace_loadable(tmp_path):
+    """trace.jsonl must parse BOTH as the Chrome trace JSON array format
+    (leading '[', trailing commas, ']' optional) and line-by-line."""
+    tr = obs.Tracer()
+    with tr.span("phase", args={"k": 1}):
+        tr.instant("marker", cat="search")
+    tr.counter("frontier", {"depth": 3})
+    p = tr.dump(str(tmp_path / "trace.jsonl"))
+
+    text = pathlib.Path(p).read_text()
+    assert text.startswith("[\n")
+    # chrome://tracing's parser: complete the array and load it whole
+    whole = json.loads(text.rstrip().rstrip(",") + "]")
+    assert {e["name"] for e in whole} == {"phase", "marker", "frontier"}
+    for e in whole:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
+    # line-by-line (jq/grep style) via the tolerant loader
+    evs = obs.load_trace(p)
+    assert len(evs) == 3
+    x = [e for e in evs if e["ph"] == "X"][0]
+    assert x["dur"] >= 0
+
+
+def test_tracer_event_cap(tmp_path):
+    tr = obs.Tracer(max_events=3)
+    for i in range(10):
+        tr.instant(f"e{i}")
+    assert len(tr.events()) == 3
+    assert tr.dropped == 7
+    # truncation is recorded IN the dumped file, not silent
+    evs = obs.load_trace(tr.dump(str(tmp_path / "t.jsonl")))
+    marker = [e for e in evs if e["name"] == "trace_truncated"]
+    assert marker and marker[0]["args"]["dropped_events"] == 7
+
+
+# ---------------------------------------------------------------------------
+# metrics
+
+def test_histogram_bucket_math():
+    h = obs_metrics.Histogram(bounds=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.001, 0.005, 0.05, 99.0):
+        h.observe(v)
+    # per-bucket (non-cumulative) counts; one overflow bucket
+    assert h.counts == [2, 1, 1, 1]
+    assert h.count == 5
+    assert h.sum == pytest.approx(0.0565 + 99.0)
+    assert h.min == 0.0005 and h.max == 99.0
+    assert h.quantile(0.5) == 0.01
+    assert h.quantile(0.99) == 99.0       # overflow reports the max
+    d = h.to_dict()
+    assert d["buckets_le"] == [0.001, 0.01, 0.1]
+    assert len(d["counts"]) == len(d["buckets_le"]) + 1
+    assert obs_metrics.Histogram().quantile(0.5) is None
+
+
+def test_registry_labels_and_snapshot():
+    reg = obs.Registry()
+    reg.inc("ops", f="read")
+    reg.inc("ops", 2, f="read")
+    reg.inc("ops", f="write")
+    reg.set_gauge("depth", 7)
+    reg.max_gauge("depth_max", 3)
+    reg.max_gauge("depth_max", 9)
+    reg.max_gauge("depth_max", 5)
+    reg.observe("lat", 0.002)
+    snap = reg.snapshot()
+    assert snap["counters"]["ops{f=read}"] == 3
+    assert snap["counters"]["ops{f=write}"] == 1
+    assert snap["gauges"]["depth"] == 7
+    assert snap["gauges"]["depth_max"] == 9
+    assert snap["histograms"]["lat"]["count"] == 1
+    # snapshot is plain JSON
+    json.dumps(snap)
+
+
+def test_metrics_snapshot_roundtrip_through_store(tmp_path):
+    """The store encoder must serialize snapshots containing numpy
+    scalars/arrays and Path values without call-site casts (the
+    satellite fix: np.bool_ and pathlib.Path used to fall back to
+    repr strings)."""
+    np = pytest.importorskip("numpy")
+    reg = obs.Registry()
+    reg.inc("explored", np.int64(42))
+    reg.set_gauge("load", np.float32(0.5))
+    reg.set_gauge("dropped", np.bool_(False))
+    reg.set_gauge("shards", np.array([3, 1]))
+    reg.set_gauge("dir", pathlib.Path("/tmp/x"))
+    p = str(tmp_path / "metrics.json")
+    store._dump_json(reg.snapshot(), p)
+    back = json.load(open(p))
+    assert back["counters"]["explored"] == 42
+    assert back["gauges"]["load"] == 0.5
+    assert back["gauges"]["dropped"] is False
+    assert back["gauges"]["shards"] == [3, 1]
+    assert back["gauges"]["dir"] == "/tmp/x"
+
+
+# ---------------------------------------------------------------------------
+# generator.trace -> tracer (one event stream, not two)
+
+def test_generator_trace_routes_through_tracer(caplog):
+    import logging
+    tr = obs.Tracer()
+    g = gen.trace("tag", gen.limit(2, gen.repeat({"f": "read"})))
+    with obs.bind(tr, None), caplog.at_level(logging.INFO):
+        hist = gtest.quick(g)
+    assert len([o for o in hist if o["type"] == "invoke"]) == 2
+    evs = [e for e in tr.events() if e["name"] == "gen.tag"]
+    kinds = {e["args"]["kind"] for e in evs}
+    assert {"op", "update"} <= kinds
+    # the original logging behavior is preserved alongside
+    assert any("tag op ->" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# full-run wiring: lifecycle spans, op spans, nemesis windows, control
+# spans, checker spans, persisted artifacts
+
+class _WindowNemesis:
+    """Minimal nemesis with a start/stop fault window."""
+
+    def setup(self, test):
+        return self
+
+    def invoke(self, test, op):
+        out = dict(op)
+        out["type"] = "info"
+        out["value"] = "zap"
+        return out
+
+    def teardown(self, test):
+        pass
+
+    def fs(self):
+        return {"start", "stop"}
+
+
+def _run_dummy(name, **kw):
+    import jepsen_tpu.nemesis as jnemesis
+
+    class N(_WindowNemesis, jnemesis.Nemesis):
+        pass
+
+    state = Atom(None)
+    rng = random.Random(45100)
+    t = dummy_test(
+        name=name,
+        db=tst.atom_db(state),
+        client=tst.atom_client(state),
+        nemesis=N(),
+        concurrency=4,
+        generator=gen.phases(
+            gen.nemesis(gen.limit(1, {"f": "start"})),
+            gen.clients(gen.limit(30, gen.mix([
+                lambda: {"f": "read"},
+                lambda: {"f": "write", "value": rng.randint(0, 4)},
+            ]))),
+            gen.nemesis(gen.limit(1, {"f": "stop"})),
+        ),
+        **kw,
+    )
+    return core.run(t)
+
+
+def _store_file(test, name):
+    return pathlib.Path(store.path(test, name))
+
+
+def test_run_writes_trace_and_metrics_with_lifecycle_phases():
+    test = _run_dummy("obs-smoke")
+    trace_path = _store_file(test, "trace.jsonl")
+    metrics_path = _store_file(test, "metrics.json")
+    assert trace_path.exists() and metrics_path.exists()
+
+    evs = obs.load_trace(str(trace_path))
+    spans = {e["name"] for e in evs if e["ph"] == "X"
+             and e.get("cat") == "lifecycle"}
+    # the run lifecycle is fully traced
+    assert {"jepsen.run", "client-nemesis.setup", "run-case",
+            "analyze", "client-nemesis.teardown"} <= spans
+    # root span wraps everything: jepsen.run has no parent, analyze does
+    by_name = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert by_name["analyze"]["args"]["parent"] == "jepsen.run"
+    assert "parent" not in (by_name["jepsen.run"].get("args") or {})
+
+    # per-op invoke->complete spans on logical-worker tracks
+    ops = [e for e in evs if e.get("cat") == "op" and e["ph"] == "X"]
+    assert len(ops) >= 30
+    assert {e["args"]["type"] for e in ops} <= {"ok", "fail", "info"}
+    assert all(isinstance(e["tid"], int) for e in ops)
+
+    # nemesis invocation spans + one open/close fault window pair
+    nem = [e for e in evs if e.get("cat") == "nemesis"]
+    assert {e["ph"] for e in nem} >= {"X", "b", "e"}
+    b = [e for e in nem if e["ph"] == "b"][0]
+    e_ = [e for e in nem if e["ph"] == "e"][0]
+    assert b["id"] == e_["id"]
+
+    # checker spans carry the verdict
+    checks = [e for e in evs if e.get("cat") == "checker"]
+    assert checks and any(c["args"]["valid"] == "True" for c in checks)
+
+    # metrics: op counts + latency histograms persisted as plain JSON
+    m = json.loads(metrics_path.read_text())
+    done = {k: v for k, v in m["counters"].items()
+            if k.startswith("interpreter.ops_completed")}
+    assert sum(done.values()) >= 30
+    lat = m["histograms"]["interpreter.op_latency_s"]
+    assert lat["count"] >= 30 and lat["sum"] > 0
+    assert m["counters"]["nemesis.ops{f=start}"] == 1
+    assert m["counters"]["nemesis.faults_started"] == 1
+    ck = {k: v for k, v in m["counters"].items()
+          if k.startswith("checker.checks")}
+    assert ck
+
+    # after the run the process-global binding is gone
+    assert not obs.enabled()
+
+
+def test_crashed_run_still_writes_artifacts():
+    """A crashed run is exactly the one whose trace matters: artifacts
+    persist from the finally path, and the obs handles are released."""
+    from jepsen_tpu import db as jdb
+
+    class BadDB(jdb.DB):
+        def setup(self, test, node):
+            raise RuntimeError("boom")
+
+    t = dummy_test(name="obs-crash", db=BadDB())
+    with pytest.raises(RuntimeError, match="boom"):
+        core.run(t)
+    # core.run worked on a prepare_test COPY of t; find the run dir on
+    # disk (exactly how a human would after a crash)
+    runs = list((pathlib.Path(store.base_dir) / "obs-crash").iterdir())
+    runs = [d for d in runs if d.is_dir() and not d.is_symlink()]
+    assert len(runs) == 1
+    trace_path = runs[0] / "trace.jsonl"
+    assert trace_path.exists()
+    assert (runs[0] / "metrics.json").exists()
+    evs = obs.load_trace(str(trace_path))
+    spans = {e["name"] for e in evs if e["ph"] == "X"}
+    # the root span closed through the unwinding context managers
+    assert "jepsen.run" in spans
+    assert not obs.enabled()
+
+
+def test_obs_opt_out():
+    test = _run_dummy("obs-off", **{"obs?": False})
+    assert test["results"]["valid"] is True
+    assert not _store_file(test, "trace.jsonl").exists()
+    assert not _store_file(test, "metrics.json").exists()
+    assert "obs" not in test
+
+
+def test_control_exec_spans():
+    """Remote exec/upload chokepoints trace per-call spans (dummy
+    transport -- same code path every real transport takes)."""
+    from jepsen_tpu import control as c
+    tr, reg = obs.Tracer(), obs.Registry()
+    test = {"nodes": ["n1"], "ssh": {"dummy?": True}}
+    with obs.bind(tr, reg):
+        with core.with_sessions(test):
+            with c.on("n1"):
+                c.exec_("echo", "hi")
+    evs = [e for e in tr.events() if e.get("cat") == "control"]
+    assert evs and evs[0]["name"] == "control.exec"
+    assert evs[0]["args"]["host"] == "n1"
+    assert "echo" in evs[0]["args"]["cmd"]
+    assert reg.counter_value("control.remote_calls", op="exec") == 1
+    assert reg.histogram("control.remote_s", op="exec").count == 1
+
+
+def test_run_with_jax_wgl_search_telemetry():
+    """The acceptance run: a local run whose checker drives the device
+    WGL engine produces metrics.json with search telemetry (states
+    explored, chunk count) and heartbeat events in trace.jsonl."""
+    from jepsen_tpu.checker import checkers as ck
+    state = Atom(None)
+    rng = random.Random(7)
+    t = dummy_test(
+        name="obs-wgl",
+        db=tst.atom_db(state),
+        client=tst.atom_client(state),
+        concurrency=3,
+        generator=gen.clients(gen.limit(24, gen.mix([
+            lambda: {"f": "read"},
+            lambda: {"f": "write", "value": rng.randint(0, 3)},
+            lambda: {"f": "cas", "value": [rng.randint(0, 3),
+                                           rng.randint(0, 3)]},
+        ]))),
+        # the AtomDB resets the register to 0, so the model starts
+        # there too (init-ops) -- otherwise a read dispatched before
+        # the first write observes 0 and the verdict flaps with the
+        # unseeded generator shuffle
+        checker=ck.linearizable({"model": "cas-register",
+                                 "algorithm": "jax-wgl",
+                                 "init-ops": [{"f": "write",
+                                               "value": 0}]}),
+    )
+    test = core.run(t)
+    assert test["results"]["valid"] is True, test["results"]
+
+    m = json.loads(_store_file(test, "metrics.json").read_text())
+    # chunk count: at least one device dispatch was heartbeat-counted
+    assert m["counters"]["wgl.chunks{engine=jax-wgl}"] >= 1
+    assert m["counters"]["wgl.searches{engine=jax-wgl}"] == 1
+    assert m["counters"]["wgl.states_explored_total{engine=jax-wgl}"] >= 0
+    assert "wgl.states_explored{engine=jax-wgl}" in m["gauges"]
+    assert "wgl.table_load{engine=jax-wgl}" in m["gauges"]
+    assert m["histograms"]["wgl.chunk_s{engine=jax-wgl}"]["count"] >= 1
+
+    evs = obs.load_trace(str(_store_file(test, "trace.jsonl")))
+    hb = [e for e in evs if e["name"] == "wgl.heartbeat.jax-wgl"]
+    assert hb and {"iteration", "frontier", "explored",
+                   "chunk_s"} <= set(hb[0]["args"])
+    done = [e for e in evs if e["name"] == "wgl.done.jax-wgl"]
+    assert done and done[0]["args"]["valid"] == "True"
+    # counter tracks render frontier/explored as Perfetto series
+    assert any(e["ph"] == "C" and e["name"] == "wgl.jax-wgl"
+               for e in evs)
+
+
+def test_search_session_pins_sinks_at_capture():
+    """A search captures its sinks ONCE at start: an engine thread the
+    checker competition abandoned (joined with timeout=0.5) must not
+    write phantom heartbeats into the NEXT run's artifacts."""
+    from jepsen_tpu.obs import search as obs_search
+    tr_a, reg_a = obs.Tracer(), obs.Registry()
+    with obs.bind(tr_a, reg_a):
+        so = obs_search.capture()
+        so.heartbeat("jax-wgl", iteration=1, chunk_s=0.1, frontier=5)
+    # run A is over, run B binds fresh sinks; the straggler keeps going
+    tr_b, reg_b = obs.Tracer(), obs.Registry()
+    with obs.bind(tr_b, reg_b):
+        so.heartbeat("jax-wgl", iteration=2, chunk_s=0.1, frontier=3)
+        so.summary("jax-wgl", {"valid": True, "configs_explored": 9})
+    # everything landed in A's sinks, nothing in B's
+    assert reg_a.counter_value("wgl.chunks", engine="jax-wgl") == 2
+    assert reg_a.counter_value("wgl.searches", engine="jax-wgl") == 1
+    assert reg_b.snapshot() == {"counters": {}, "gauges": {},
+                                "histograms": {}}
+    assert tr_b.events() == []
+    assert len([e for e in tr_a.events()
+                if e["name"] == "wgl.heartbeat.jax-wgl"]) == 2
+    # and a session captured while nothing is bound stays a no-op
+    so_off = obs_search.capture()
+    assert not so_off.enabled()
+    so_off.heartbeat("jax-wgl", iteration=1, chunk_s=0.1)
+
+
+def test_web_home_page_links_obs_artifacts():
+    """The web UI's home page lists each run's trace/metrics artifacts
+    (served by the existing /files handler)."""
+    import urllib.parse
+
+    from jepsen_tpu import web
+    test = _run_dummy("obs-web")
+    page = web._home_page()
+    quoted = urllib.parse.quote(test["start-time"])
+    assert "Observability" in page
+    assert f"{quoted}/trace.jsonl" in page
+    assert f"{quoted}/metrics.json" in page
+
+
+def test_obs_in_test_map_is_not_serialized():
+    test = _run_dummy("obs-noser")
+    t = store.serializable_test(test)
+    assert "obs" not in t
+    # and test.json on disk parses cleanly
+    loaded = store.load(test["name"], test["start-time"])
+    assert loaded["results"]["valid"] is True

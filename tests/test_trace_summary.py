@@ -1,0 +1,57 @@
+"""tools/trace_summary.py end to end: a real (dummy-transport) run's
+store directory in, human-readable phase/latency/telemetry summary out."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from jepsen_tpu import store
+
+from test_obs import _run_dummy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "trace_summary.py")
+
+
+@pytest.fixture(scope="module")
+def run_dir(tmp_path_factory):
+    """One dummy run shared by both tests (module-scoped store)."""
+    base = tmp_path_factory.mktemp("store")
+    prev = store.base_dir
+    store.base_dir = str(base)
+    try:
+        test = _run_dummy("summary-e2e")
+        yield store.path(test)
+    finally:
+        store.base_dir = prev
+
+
+def test_summarize_function(run_dir):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import trace_summary
+    finally:
+        sys.path.pop(0)
+    out = trace_summary.summarize(run_dir)
+    assert "lifecycle phases" in out
+    assert "jepsen.run" in out and "run-case" in out
+    assert "op latency" in out and "p50" in out
+    assert "op counts" in out
+    assert "interpreter.ops_completed" in out
+
+
+def test_cli_end_to_end(run_dir):
+    p = subprocess.run([sys.executable, TOOL, run_dir],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr
+    assert "jepsen.run" in p.stdout
+    assert "p50" in p.stdout
+
+
+def test_cli_bad_dir():
+    p = subprocess.run([sys.executable, TOOL, "/nonexistent-dir-xyz"],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 1
+    assert "not a directory" in p.stderr

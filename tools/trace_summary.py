@@ -1,0 +1,126 @@
+"""Summarize a test run's observability artifacts from its store dir.
+
+Reads ``trace.jsonl`` + ``metrics.json`` (written by jepsen_tpu.store
+next to results.json) and prints:
+
+* per-lifecycle-phase wall time (the ``X`` spans with cat=lifecycle),
+* op-latency quantiles (p50/p90/p99) from the interpreter's op spans,
+  falling back to the metrics histogram when the trace has no op spans,
+* op counts by f/type and the WGL search telemetry (states explored,
+  chunk count, dedup-table load) from metrics.json.
+
+Usage::
+
+    python tools/trace_summary.py [STORE_DIR]
+
+STORE_DIR defaults to ``store/latest``. Accepts either a run directory
+(containing trace.jsonl) or anything with those two files in it.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_trace(path):
+    from jepsen_tpu.obs import load_trace
+    return load_trace(path)
+
+
+def _quantile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    # nearest-rank: smallest index covering a q fraction of the sample
+    # (int(q*len) would bias high -- p50 of 2 samples must be the lower)
+    i = max(0, min(len(sorted_vals) - 1,
+                   math.ceil(q * len(sorted_vals)) - 1))
+    return sorted_vals[i]
+
+
+def _fmt_s(us):
+    return f"{us / 1e6:10.3f}s"
+
+
+def summarize(store_dir):
+    """Render the summary for one run directory; returns the text."""
+    lines = [f"== {store_dir} =="]
+    trace_path = os.path.join(store_dir, "trace.jsonl")
+    metrics_path = os.path.join(store_dir, "metrics.json")
+
+    events = []
+    if os.path.exists(trace_path):
+        events = _load_trace(trace_path)
+
+    # -- per-phase wall time -------------------------------------------
+    phases = [e for e in events
+              if e.get("ph") == "X" and e.get("cat") == "lifecycle"]
+    if phases:
+        lines.append("\n-- lifecycle phases (wall time) --")
+        for e in sorted(phases, key=lambda e: e["ts"]):
+            lines.append(f"{_fmt_s(e.get('dur', 0.0))}  {e['name']}")
+
+    # -- op latency quantiles ------------------------------------------
+    op_durs_us = sorted(e.get("dur", 0.0) for e in events
+                        if e.get("ph") == "X" and e.get("cat") == "op")
+    if op_durs_us:
+        lines.append(f"\n-- op latency ({len(op_durs_us)} ops, "
+                     "from trace spans) --")
+        for q in (0.5, 0.9, 0.99):
+            v = _quantile(op_durs_us, q)
+            lines.append(f"p{int(q * 100):<3} {v / 1e3:10.3f} ms")
+
+    metrics = None
+    if os.path.exists(metrics_path):
+        with open(metrics_path) as f:
+            metrics = json.load(f)
+
+    if metrics:
+        if not op_durs_us:
+            h = metrics.get("histograms", {}) \
+                .get("interpreter.op_latency_s")
+            if h and h.get("count"):
+                lines.append(f"\n-- op latency ({h['count']} ops, "
+                             "from metrics histogram) --")
+                lines.append(
+                    f"mean {h['sum'] / h['count'] * 1e3:10.3f} ms   "
+                    f"max {h['max'] * 1e3:10.3f} ms")
+        counters = metrics.get("counters", {})
+        ops = {k: v for k, v in sorted(counters.items())
+               if k.startswith("interpreter.ops_completed")}
+        if ops:
+            lines.append("\n-- op counts --")
+            for k, v in ops.items():
+                lines.append(f"{v:8d}  {k}")
+        wgl = {k: v for k, v in sorted(counters.items())
+               if k.startswith("wgl.")}
+        wgl.update({k: v for k, v in
+                    sorted(metrics.get("gauges", {}).items())
+                    if k.startswith("wgl.")})
+        if wgl:
+            lines.append("\n-- WGL search telemetry --")
+            for k, v in wgl.items():
+                lines.append(f"{v!s:>12}  {k}")
+
+    if len(lines) == 1:
+        lines.append("(no trace.jsonl / metrics.json found)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    store_dir = argv[0] if argv else os.path.join("store", "latest")
+    store_dir = os.path.realpath(store_dir)
+    if not os.path.isdir(store_dir):
+        print(f"not a directory: {store_dir}", file=sys.stderr)
+        return 1
+    print(summarize(store_dir))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

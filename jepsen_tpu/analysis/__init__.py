@@ -1,0 +1,39 @@
+"""Static diagnostics for histories, test plans, kernels, and the
+framework itself.
+
+Four analyzers share one structured-diagnostic model (`Diagnostic`)
+and two renderers (`render_text` / `to_json`):
+
+* **histlint** -- history well-formedness (the linearizability
+  checkers' preconditions), over event lists and EncodedHistory
+  tensors. Runs automatically before checkers (``checker.core``); opt
+  out per test with ``test["analysis?"] = False``. Violations persist
+  to ``store/<test>/<time>/analysis.json``.
+* **planlint** -- test-map preflight before any node contact. Runs in
+  ``core.run`` (opt out with ``test["preflight?"] = False``) and via
+  ``--lint`` on the CLI.
+* **jaxlint** -- jaxpr hazard analysis of jitted WGL step functions:
+  recompilation hazards, host syncs, int32 index-width overflow.
+* **codelint** -- AST thread-safety lint over the framework's own
+  source, driven by ``tools/lint.py``.
+
+See doc/analysis.md for the code catalogue.
+"""
+
+from . import codelint, histlint, jaxlint, planlint  # noqa: F401
+from .diagnostics import (Diagnostic, ERROR, INFO,  # noqa: F401
+                          SEVERITIES, WARNING, diag, errors,
+                          max_severity, render_text, run_analyzer,
+                          severity_counts, to_json, warnings)
+from .histlint import (lint_encoded, lint_history,  # noqa: F401
+                       lint_test_history)
+from .planlint import PlanLintError, lint_plan, preflight  # noqa: F401
+
+__all__ = [
+    "Diagnostic", "ERROR", "WARNING", "INFO", "SEVERITIES", "diag",
+    "errors", "warnings", "max_severity", "severity_counts",
+    "render_text", "to_json", "run_analyzer",
+    "histlint", "planlint", "jaxlint", "codelint",
+    "lint_history", "lint_encoded", "lint_test_history",
+    "lint_plan", "preflight", "PlanLintError",
+]

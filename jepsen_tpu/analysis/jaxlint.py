@@ -1,0 +1,240 @@
+"""jaxlint: jaxpr-level hazard analysis of the jitted WGL step
+functions.
+
+The device search (checker/jax_wgl.py, parallel/keyshard.py,
+parallel/searchshard.py) jits one kernel per shape bundle and reuses it
+across histories. A badly-shaped model ``step`` silently breaks that
+contract: a weak-typed Python scalar capture retraces on dtype
+promotion changes, a large captured constant bakes history data into
+the executable (one compile per history), and a host callback inside
+the ``lax.while_loop`` body syncs the device every iteration. None of
+these crash -- they just make the search quietly slow. This analyzer
+traces the function once and walks the jaxpr for those hazards, plus
+the int32 index-width limits of the encoded-history layout.
+
+Codes:
+
+  JX000 error    the function failed to trace at all (Python control
+                 flow on traced values, shape errors, ...)
+  JX001 warning  weak-typed scalar capture/input (recompilation hazard:
+                 Python scalars retrace under dtype promotion)
+  JX002 warning  large constant array captured by closure (bakes data
+                 into the compiled kernel; recompiles per history)
+  JX003 error    host callback primitive inside the jitted function
+                 (implicit host-device sync in the search loop)
+  JX004 error    encoded history exceeds int32 index width (~2^31
+                 encoded cells): device indices overflow
+  JX005 warning  encoded history within 2x of the int32 index ceiling
+  JX006 warning  dtype-widening op (int64/float64) in the jaxpr: the
+                 search is an int32 kernel; x64 doubles HBM traffic
+
+Everything here imports jax lazily so the analyzer surface can load in
+jax-free tooling contexts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .diagnostics import ERROR, WARNING, diag
+
+__all__ = ["lint_fn", "lint_jaxpr", "lint_model_spec",
+           "lint_history_size", "lint_search_plan",
+           "INT32_CELL_LIMIT", "HOST_CALLBACK_PRIMITIVES"]
+
+#: primitives that round-trip to the host (an implicit sync when they
+#: appear inside the search's while_loop body)
+HOST_CALLBACK_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "host_callback_call", "outside_call", "debug_print",
+})
+
+#: cells (int32 lanes) addressable before device indices overflow
+INT32_CELL_LIMIT = 2 ** 31
+
+#: captured constants larger than this many elements are flagged JX002
+CONST_ELEMENT_LIMIT = 1024
+
+_WIDE_DTYPES = ("int64", "uint64", "float64")
+
+
+def _iter_jaxprs(jaxpr):
+    """Yield a jaxpr and every sub-jaxpr reachable through eqn params
+    (cond/while/scan branches, pjit bodies, ...)."""
+    seen = []
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        if any(j is s for s in seen):
+            continue
+        seen.append(j)
+        yield j
+        for eqn in j.eqns:
+            for v in eqn.params.values():
+                for sub in _as_jaxprs(v):
+                    stack.append(sub)
+
+
+def _as_jaxprs(v):
+    # jax.core.Jaxpr / ClosedJaxpr, possibly nested in lists/tuples
+    if hasattr(v, "eqns"):
+        return [v]
+    if hasattr(v, "jaxpr"):
+        return [v.jaxpr]
+    if isinstance(v, (list, tuple)):
+        out = []
+        for x in v:
+            out.extend(_as_jaxprs(x))
+        return out
+    return []
+
+
+def lint_jaxpr(closed, where="jaxpr"):
+    """Walk a ClosedJaxpr for JX001/JX002/JX003/JX006."""
+    diags = []
+    jaxpr = getattr(closed, "jaxpr", closed)
+    consts = list(getattr(closed, "consts", ()) or ())
+
+    for var, const in zip(jaxpr.constvars, consts):
+        aval = var.aval
+        size = int(np.prod(getattr(aval, "shape", ()) or (1,)))
+        if size > CONST_ELEMENT_LIMIT:
+            diags.append(diag(
+                "JX002", WARNING,
+                f"closure captures a {aval.str_short()} constant "
+                f"({size} elements): history-sized data baked into the "
+                "compiled kernel forces a recompile per history",
+                where,
+                "pass the array as a traced argument instead of "
+                "closing over it"))
+        if getattr(aval, "weak_type", False):
+            diags.append(diag(
+                "JX001", WARNING,
+                f"closure captures a weak-typed scalar "
+                f"({aval.str_short(short_dtypes=True)}): Python "
+                "number captures retrace under dtype promotion",
+                where,
+                "wrap the scalar in np.int32/jnp.asarray at build "
+                "time"))
+    for var in jaxpr.invars:
+        if getattr(var.aval, "weak_type", False):
+            diags.append(diag(
+                "JX001", WARNING,
+                "weak-typed scalar input: passing Python numbers "
+                "positionally retraces per call site",
+                where,
+                "pass numpy/jax scalars with explicit dtypes"))
+
+    wide_seen = set()
+    for j in _iter_jaxprs(jaxpr):
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if name in HOST_CALLBACK_PRIMITIVES:
+                diags.append(diag(
+                    "JX003", ERROR,
+                    f"host callback primitive '{name}' inside the "
+                    "jitted function: every search iteration would "
+                    "sync with the host",
+                    where,
+                    "hoist host I/O out of the step function; use "
+                    "harvested counters instead"))
+            for var in eqn.outvars:
+                dt = str(getattr(var.aval, "dtype", ""))
+                if dt in _WIDE_DTYPES and dt not in wide_seen:
+                    wide_seen.add(dt)
+                    diags.append(diag(
+                        "JX006", WARNING,
+                        f"op '{name}' produces {dt}: the search kernel "
+                        "is int32/uint32 end to end; 64-bit lanes "
+                        "double HBM traffic",
+                        where,
+                        "keep model state and arithmetic in int32"))
+    return diags
+
+
+def lint_fn(fn, *example_args, where=None):
+    """Trace ``fn`` with example arguments and lint the jaxpr. Returns
+    (diagnostics, ClosedJaxpr|None); tracing failures are reported as a
+    JX000 diagnostic rather than raised."""
+    import jax
+    where = where or f"jaxpr:{getattr(fn, '__name__', 'fn')}"
+    try:
+        closed = jax.make_jaxpr(fn)(*example_args)
+    except Exception as e:  # noqa: BLE001 - report, don't crash the lint
+        return [diag("JX000", ERROR,
+                     f"function failed to trace: {e!r}", where,
+                     "step functions must be traceable (branch-free, "
+                     "no Python control flow on traced values)")], None
+    return lint_jaxpr(closed, where), closed
+
+
+def lint_model_spec(spec, state_size=4, arg_width=None):
+    """Lint a ModelSpec's tensor-face ``step`` the way the WGL kernels
+    jit it: int32 state/args vectors, int32 scalar f."""
+    import jax.numpy as jnp
+    A = arg_width if arg_width is not None else spec.arg_width
+    S = state_size
+    st = jnp.zeros((S,), jnp.int32)
+    f = jnp.int32(0)
+    args = jnp.full((A,), 0, jnp.int32)
+    ret = jnp.full((A,), 0, jnp.int32)
+
+    def step(st, f, args, ret):
+        st2, ok = spec.step(st, f, args, ret, jnp)
+        return st2, ok
+
+    diags, _ = lint_fn(step, st, f, args, ret,
+                       where=f"jaxpr:{spec.name}.step")
+    return diags
+
+
+def lint_history_size(n, arg_width=1, keys=1, where="encoded-history"):
+    """JX004/JX005: int32 index-width conformance of an encoded history.
+
+    The device layout addresses ``keys * n * (2*arg_width + 4)`` encoded
+    cells (invoke/return/f/ok plus args+ret vectors) with int32 lane
+    indices, and ``_encode_arrays`` re-ranks event indices into int32
+    (two events per op). Beyond ~2^31 cells the flat gathers'
+    index arithmetic overflows."""
+    diags = []
+    cells = int(keys) * int(n) * (2 * int(arg_width) + 4)
+    ranks = 2 * int(n)
+    if cells >= INT32_CELL_LIMIT or ranks >= INT32_CELL_LIMIT:
+        diags.append(diag(
+            "JX004", ERROR,
+            f"history encodes {cells:,} cells ({n:,} ops x "
+            f"{keys} key(s)): int32 device indices overflow at 2^31",
+            where,
+            "shard the history (parallel.keyshard / searchshard) or "
+            "partition by key before encoding"))
+    elif cells >= INT32_CELL_LIMIT // 2:
+        diags.append(diag(
+            "JX005", WARNING,
+            f"history encodes {cells:,} cells: within 2x of the int32 "
+            "index ceiling (2^31)",
+            where,
+            "plan for key sharding before the workload grows"))
+    return diags
+
+
+def lint_search_plan(n, S, C=None, keys=1, arg_width=1,
+                     where="search-plan"):
+    """Lint the buffer plan jax_wgl would build for an n-op history:
+    index-width conformance of the stack/table layouts plus the
+    history-size checks. Imports the checker lazily."""
+    from ..checker import jax_wgl
+    diags = lint_history_size(n, arg_width=arg_width, keys=keys,
+                              where=where)
+    C = C if C is not None else max(1, min(n, 64))
+    B, W, O, T = jax_wgl._plan_sizes(n, S, C)
+    for label, cells in (("stack", keys * O * (B + S)),
+                         ("dedup table", T * 2),
+                         ("frontier step", keys * W * C * S)):
+        if cells >= INT32_CELL_LIMIT:
+            diags.append(diag(
+                "JX004", ERROR,
+                f"{label} spans {cells:,} int32 cells (>= 2^31): "
+                "device index arithmetic overflows",
+                where,
+                "lower frontier_width/stack_size or shard the search"))
+    return diags

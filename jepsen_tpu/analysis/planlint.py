@@ -1,0 +1,244 @@
+"""planlint: pre-flight validation of a test map, before any node
+contact.
+
+``core.run`` wires a whole protocol zoo together from one plain dict;
+a malformed plan typically fails minutes in -- after SSH sessions, OS
+and DB setup -- with a stack trace far from the mistake. This analyzer
+checks the wiring statically: protocol conformance of
+client/nemesis/checker, generator plausibility (including literal op
+:f values against the model's supported op set), and concurrency /
+process-count sanity.
+
+Codes:
+
+  PL001 error    client missing or lacks a callable ``invoke``
+  PL002 warning  client/nemesis partially implements its protocol
+  PL003 error    nemesis lacks a callable ``invoke``
+  PL004 error    checker lacks a callable ``check`` and is not callable
+  PL005 error    generator has an unusable type
+  PL006 error    concurrency is not a positive integer
+  PL007 warning  node/concurrency mismatch (idle nodes, non-multiple)
+  PL008 error    a literal generator op's :f is outside the model's op
+                 set
+  PL009 warning  a literal nemesis op's :f is not in ``nemesis.fs()``
+  PL010 warning  non-positive time-limit / test-count
+
+``preflight(test)`` is the core.run hook: FATAL codes raise
+``PlanLintError`` (opt out per test with ``test["preflight?"] =
+False``); everything else is logged and recorded.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .diagnostics import ERROR, WARNING, diag, errors, render_text
+from .histlint import model_op_set
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["lint_plan", "preflight", "PlanLintError", "FATAL_CODES"]
+
+#: error codes certain enough to abort the run before node contact
+FATAL_CODES = {"PL001", "PL003", "PL004", "PL005", "PL006"}
+
+_CLIENT_PROTOCOL = ("open", "setup", "invoke", "teardown", "close",
+                    "reusable")
+_NEMESIS_PROTOCOL = ("setup", "invoke", "teardown")
+
+
+class PlanLintError(ValueError):
+    """A test plan failed preflight with fatal diagnostics."""
+
+    def __init__(self, diags):
+        self.diagnostics = diags
+        super().__init__(render_text(diags, title="test plan preflight "
+                                                  "failed:"))
+
+
+def _callable_attr(obj, name):
+    return callable(getattr(obj, name, None))
+
+
+def lint_plan(test):
+    """Lint a test map. Returns a list of Diagnostics (never raises)."""
+    diags = []
+    if not isinstance(test, dict):
+        return [diag("PL005", ERROR, f"test plan is not a mapping: "
+                                     f"{type(test).__name__}", "plan")]
+
+    # -- client --------------------------------------------------------
+    client = test.get("client")
+    if client is None or not _callable_attr(client, "invoke"):
+        diags.append(diag(
+            "PL001", ERROR,
+            "client is missing or has no callable invoke(test, op)",
+            "plan.client",
+            "provide a jepsen_tpu.client.Client (client.noop for none)"))
+    else:
+        missing = [m for m in _CLIENT_PROTOCOL
+                   if not _callable_attr(client, m)]
+        if missing:
+            diags.append(diag(
+                "PL002", WARNING,
+                f"client lacks protocol method(s) {missing}",
+                "plan.client",
+                "subclass jepsen_tpu.client.Client to inherit the "
+                "defaults"))
+
+    # -- nemesis -------------------------------------------------------
+    nemesis = test.get("nemesis")
+    nemesis_fs = None
+    if nemesis is not None:
+        if not _callable_attr(nemesis, "invoke"):
+            diags.append(diag(
+                "PL003", ERROR,
+                "nemesis has no callable invoke(test, op)",
+                "plan.nemesis",
+                "subclass jepsen_tpu.nemesis.Nemesis (nemesis.noop for "
+                "none)"))
+        else:
+            missing = [m for m in _NEMESIS_PROTOCOL
+                       if not _callable_attr(nemesis, m)]
+            if missing:
+                diags.append(diag(
+                    "PL002", WARNING,
+                    f"nemesis lacks protocol method(s) {missing}",
+                    "plan.nemesis"))
+            try:
+                fs = nemesis.fs() if _callable_attr(nemesis, "fs") \
+                    else None
+                nemesis_fs = set(fs) if fs else None
+            except Exception:  # noqa: BLE001 - reflection is optional
+                nemesis_fs = None
+
+    # -- checker -------------------------------------------------------
+    checker = test.get("checker")
+    if checker is not None and not _callable_attr(checker, "check") \
+            and not callable(checker):
+        diags.append(diag(
+            "PL004", ERROR,
+            "checker has no callable check(test, history, opts) and is "
+            "not itself callable",
+            "plan.checker",
+            "provide a jepsen_tpu.checker.Checker (checker.noop() for "
+            "none)"))
+
+    # -- generator -----------------------------------------------------
+    gen_ = test.get("generator")
+    if not _generator_like(gen_):
+        diags.append(diag(
+            "PL005", ERROR,
+            f"generator has unusable type {type(gen_).__name__}",
+            "plan.generator",
+            "use op dicts, callables, Generator combinators, or "
+            "sequences thereof"))
+
+    # -- concurrency / process counts ---------------------------------
+    nodes = test.get("nodes") or []
+    conc = test.get("concurrency", len(nodes))
+    if not isinstance(conc, int) or isinstance(conc, bool) or conc <= 0:
+        diags.append(diag(
+            "PL006", ERROR,
+            f"concurrency must be a positive integer, got {conc!r}",
+            "plan.concurrency"))
+    elif nodes:
+        if conc < len(nodes):
+            diags.append(diag(
+                "PL007", WARNING,
+                f"concurrency {conc} < {len(nodes)} nodes: "
+                f"{len(nodes) - conc} node(s) never receive a client",
+                "plan.concurrency",
+                "use a multiple of the node count (e.g. \"1n\")"))
+        elif conc % len(nodes):
+            diags.append(diag(
+                "PL007", WARNING,
+                f"concurrency {conc} is not a multiple of the "
+                f"{len(nodes)}-node count: client load is uneven",
+                "plan.concurrency"))
+
+    # -- literal generator ops vs model / nemesis op sets -------------
+    model_fs = model_op_set(test)
+    if model_fs is not None or nemesis_fs is not None:
+        for op in _literal_ops(gen_):
+            f = op.get("f")
+            # nemesis literal ops carry {"type": "info"} (or an explicit
+            # nemesis process); client ops are invokes or bare op maps
+            is_nemesis = op.get("process") == "nemesis" \
+                or op.get("type") == "info"
+            if is_nemesis:
+                if nemesis_fs is not None and f not in nemesis_fs:
+                    diags.append(diag(
+                        "PL009", WARNING,
+                        f"nemesis op :f {f!r} is not in nemesis.fs() "
+                        f"{sorted(map(str, nemesis_fs))}",
+                        "plan.generator"))
+            elif model_fs is not None and f is not None \
+                    and op.get("type") in (None, "invoke") \
+                    and f not in model_fs:
+                diags.append(diag(
+                    "PL008", ERROR,
+                    f"generator emits op :f {f!r} outside the model's "
+                    f"op set {sorted(map(str, model_fs))}",
+                    "plan.generator",
+                    "the linearizable checker cannot step this op"))
+
+    # -- misc scalars --------------------------------------------------
+    for key in ("time-limit", "test-count"):
+        v = test.get(key)
+        if v is not None and (not isinstance(v, (int, float))
+                              or isinstance(v, bool) or v <= 0):
+            diags.append(diag(
+                "PL010", WARNING,
+                f"{key} should be a positive number, got {v!r}",
+                f"plan.{key}"))
+    return diags
+
+
+def _generator_like(g, depth=0):
+    """Anything generator.validate can drive: None (empty), op dicts,
+    callables, Generator objects (duck-typed on op/update), sequences
+    and iterators of the same."""
+    if g is None or isinstance(g, dict) or callable(g):
+        return True
+    if hasattr(g, "op") or hasattr(g, "update"):
+        return True
+    if depth < 2 and isinstance(g, (list, tuple)):
+        return all(_generator_like(x, depth + 1) for x in g)
+    return hasattr(g, "__iter__") or hasattr(g, "__next__")
+
+
+def _literal_ops(g, depth=0, budget=None):
+    """Walk a generator structure collecting literal op dicts -- the
+    statically-knowable subset (function generators are opaque).
+    Combinator objects are traversed through their attributes."""
+    if budget is None:
+        budget = [512]
+    if budget[0] <= 0 or depth > 8 or g is None or callable(g):
+        return
+    budget[0] -= 1
+    if isinstance(g, dict):
+        if "f" in g:
+            yield g
+        return
+    if isinstance(g, (list, tuple)):
+        for x in g[:64]:
+            yield from _literal_ops(x, depth + 1, budget)
+        return
+    if hasattr(g, "__dict__"):
+        for v in vars(g).values():
+            yield from _literal_ops(v, depth + 1, budget)
+
+
+def preflight(test, strict=True):
+    """core.run's preflight phase. Lints the plan, logs findings, and
+    raises PlanLintError on FATAL_CODES when ``strict``. Returns the
+    diagnostics list."""
+    diags = lint_plan(test)
+    if diags:
+        logger.warning("%s", render_text(diags, title="test plan "
+                                                      "preflight:"))
+    fatal = [d for d in errors(diags) if d.code in FATAL_CODES]
+    if strict and fatal:
+        raise PlanLintError(fatal)
+    return diags

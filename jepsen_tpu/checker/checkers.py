@@ -10,7 +10,6 @@ dispatches on "algorithm" exactly like the reference dispatches to knossos
 from __future__ import annotations
 
 import collections
-import concurrent.futures
 import logging
 import re
 import threading
@@ -18,8 +17,7 @@ import threading
 from .. import history as h
 from .. import obs
 from ..models import base as mbase
-from ..util import nanos_to_secs
-from .core import Checker, compose, merge_valid
+from .core import Checker, merge_valid
 
 logger = logging.getLogger(__name__)
 

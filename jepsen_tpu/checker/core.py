@@ -7,6 +7,7 @@ False > "unknown" > True (checker.clj:29-50).
 
 from __future__ import annotations
 
+import logging
 import threading
 import traceback
 
@@ -15,7 +16,10 @@ from .. import obs
 from ..util import real_pmap
 
 __all__ = ["Checker", "check", "check_safe", "compose", "concurrency_limit",
-           "noop", "unbridled_optimism", "merge_valid", "valid_prio"]
+           "noop", "unbridled_optimism", "merge_valid", "valid_prio",
+           "lint_history"]
+
+logger = logging.getLogger(__name__)
 
 
 def valid_prio(v):
@@ -76,8 +80,46 @@ def checker_name(checker):
     return getattr(checker, "name", None) or type(checker).__name__
 
 
+_lint_lock = threading.Lock()
+
+
+def lint_history(test, hist):
+    """Run histlint over ``hist`` once per test map, before checkers see
+    it: diagnostics land in ``test["analysis"]["history"]``
+    (store.write_analysis persists them as analysis.json) and error
+    findings are logged. Opt out per test with ``test["analysis?"] =
+    False``. Runs at most once per test dict -- Compose fans every
+    subchecker back through check(), and the history doesn't change.
+
+    Lint failures are contained: a bug in the analyzer must never
+    change a verdict."""
+    if not isinstance(test, dict) or not test.get("analysis?", True):
+        return
+    with _lint_lock:
+        if test.get("analysis-done?"):
+            return
+        test["analysis-done?"] = True
+    try:
+        from .. import analysis
+        diags = analysis.run_analyzer(
+            "histlint", analysis.lint_test_history, test, hist)
+        report = analysis.to_json(diags)
+        test.setdefault("analysis", {})["history"] = report
+        errs = analysis.errors(diags)
+        if errs:
+            logger.warning(
+                "%s", analysis.render_text(
+                    errs, title="history lint found structural "
+                                "defects; the verdict below may not "
+                                "be trustworthy:"))
+    except Exception:  # noqa: BLE001 - telemetry, never verdict-bearing
+        logger.warning("history lint crashed", exc_info=True)
+
+
 def check(checker, test, hist, opts=None):
-    return as_checker(checker).check(test, h.ensure_indexed(hist), opts or {})
+    hist = h.ensure_indexed(hist)
+    lint_history(test, hist)
+    return as_checker(checker).check(test, hist, opts or {})
 
 
 def check_safe(checker, test, hist, opts=None):

@@ -181,7 +181,8 @@ def _build_search(step_fn, K, n, B, S, C, A, W, O, T, G=1, R=None,
     claim (G,Tc) i32 shared, tfail (G,) i32 shared (dedup insert-failure
     count; v6). G is the table-group count: 1 locally; under shard_map over a
     mesh, G = mesh size so each device shard sees exactly one group (the
-    body always indexes group 0 of its local view). Buffers depend on O/B/S/T but NOT on W, so kernel variants with
+    body always indexes group 0 of its local view). Buffers depend
+    on O/B/S/T but NOT on W, so kernel variants with
     different frontier widths are interchangeable mid-search (the batch
     checker widens W once stragglers remain).
     """
@@ -1100,8 +1101,9 @@ def _prepare_search(spec, e, init_state, confirm=False):
             return ("fast", _fast_result(spec, e, init_state, fast,
                                          confirm))
     inv32, ret32 = _apply_prune(spec, e, inv32, ret32)
-    C = max_point_concurrency(inv32, np.where(ret32 == INF32,
-                                              INF_TIME, ret32.astype(np.int64)))
+    C = max_point_concurrency(
+        inv32,
+        np.where(ret32 == INF32, INF_TIME, ret32.astype(np.int64)))
     A = int(e.args.shape[1]) if e.args.ndim == 2 else 1
     perm, inv32, ret32, fop, args, rets, ok_words = _priority_order(
         spec, e, inv32, ret32)

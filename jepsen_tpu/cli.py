@@ -63,6 +63,10 @@ def add_test_opts(parser):
                         metavar="SECONDS",
                         help="How long the test runs, excluding setup and "
                              "teardown.")
+    parser.add_argument("--lint", action="store_true",
+                        help="Dry run: statically validate the test plan "
+                             "(planlint) and exit without contacting any "
+                             "node.")
     return parser
 
 
@@ -109,6 +113,7 @@ def test_opt_fn(opts):
     }
     opts["leave-db-running?"] = opts.pop("leave-db-running", False)
     opts["logging-json?"] = opts.pop("logging-json", False)
+    opts["lint?"] = opts.pop("lint", False)
     opts.pop("node", None)
     opts.pop("nodes-file", None)
     return opts
@@ -133,7 +138,20 @@ def single_test_cmd(opts):
     "opt-spec": fn(parser), "opt-fn": fn(options)}."""
     test_fn = opts["test-fn"]
 
+    def lint_test(options):
+        """--lint dry run: planlint the built test map, print the
+        report, exit 0 (clean) / 1 (error diagnostics). No node is
+        contacted, no store directory is written."""
+        from . import analysis
+        test = core.prepare_test(test_fn(options))
+        diags = analysis.lint_plan(test)
+        print(analysis.render_text(
+            diags, title=f"plan lint: {test.get('name')}"))
+        sys.exit(1 if analysis.errors(diags) else 0)
+
     def run_test(options):
+        if options.get("lint?"):
+            return lint_test(options)
         for _i in range(options.get("test-count", 1)):
             test = core.run(test_fn(options))
             code = _exit_for_valid(
@@ -142,6 +160,11 @@ def single_test_cmd(opts):
                 sys.exit(code)
 
     def run_analyze(options):
+        if options.get("lint?"):
+            # --lint means "never touch nodes or stored state" on
+            # either subcommand; without this, analyze would silently
+            # ignore the flag and kick off a full re-check
+            return lint_test(options)
         cli_test = test_fn(options)
         stored = store.latest()
         if stored is None:

@@ -9,6 +9,7 @@ iptables rules there.
 from __future__ import annotations
 
 import re
+import threading
 
 from . import exec_ as _exec
 from . import _bind, _sudo
@@ -43,17 +44,25 @@ def ip_star(host):
 
 
 _ip_cache = {}
+_ip_cache_lock = threading.Lock()
 
 
 def ip(host):
     """Look up an ip for a hostname. Memoized *per resolving node* — nodes'
     DNS views can disagree, which is the whole reason iptables rules use
-    resolved IPs (control/net.clj:38-40)."""
+    resolved IPs (control/net.clj:38-40). on_nodes pmaps resolve
+    concurrently, so the cache is locked (the resolve itself runs
+    outside the lock: two racing threads may both resolve, one result
+    wins)."""
     from . import _host
     key = (_host.get(), host)
-    if key not in _ip_cache:
-        _ip_cache[key] = ip_star(host)
-    return _ip_cache[key]
+    with _ip_cache_lock:
+        cached = _ip_cache.get(key)
+    if cached is None:
+        cached = ip_star(host)
+        with _ip_cache_lock:
+            _ip_cache[key] = cached
+    return cached
 
 
 def control_ip():

@@ -13,7 +13,7 @@ from __future__ import annotations
 import logging
 import subprocess
 
-from .core import Remote, env_string, escape, wrap_cd, wrap_sudo
+from .core import Remote, env_string, wrap_cd, wrap_sudo
 
 logger = logging.getLogger(__name__)
 

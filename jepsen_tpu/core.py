@@ -16,6 +16,7 @@ import logging
 import threading
 import traceback
 
+from . import analysis as janalysis
 from . import checker as jchecker
 from . import client as jclient
 from . import control as c
@@ -244,6 +245,24 @@ def with_client_nemesis_setup_teardown(test):
             nt.join()
 
 
+def preflight(test):
+    """Static test-plan validation before any node contact
+    (planlint): protocol conformance, generator/model op agreement,
+    concurrency sanity. Fatal wiring defects raise PlanLintError here
+    -- minutes earlier than the mid-run stack trace they would
+    otherwise become. Opt out per test with ``test["preflight?"] =
+    False``. Diagnostics are kept on the test map so store.save_1/2
+    persist them in analysis.json."""
+    if not test.get("preflight?", True):
+        return test
+    diags = janalysis.run_analyzer(
+        "planlint", janalysis.planlint.preflight, test)
+    # record even a clean report: "preflight ran, zero findings" is
+    # itself evidence when a run later goes sideways
+    test.setdefault("analysis", {})["plan"] = janalysis.to_json(diags)
+    return test
+
+
 def run_case(test):
     """Spawns nemesis and clients, runs the generator, returns the history
     (core.clj:214-219)."""
@@ -291,7 +310,7 @@ def with_logging(test):
             test["store_dir"] = store.path(test)
         logger.info("Running test: %s", test.get("name"))
         yield
-    except Exception:
+    except Exception:  # noqa: BLE001 - log the crash in-store, rethrow
         logger.warning("Test crashed!\n%s", traceback.format_exc())
         raise
     finally:
@@ -338,6 +357,9 @@ def run(test):
             with with_logging(test):
                 with obs.span("jepsen.run",
                               test_name=str(test.get("name"))):
+                    # plan preflight: fail fast on wiring defects,
+                    # before sessions/OS/DB touch any node
+                    preflight(test)
                     with with_sessions(test):
                         with with_os(test):
                             with with_db(test):

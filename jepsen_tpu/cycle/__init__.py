@@ -216,6 +216,8 @@ def transitive_closure(adj: np.ndarray) -> np.ndarray:
     fn = _closure_cache.get(n_pad)
     if fn is None:
         fn = _device_closure(n_pad)
+        # codelint: ok -- benign compile race: both racers build the
+        # same jitted closure, last write wins
         _closure_cache[n_pad] = fn
     return np.asarray(fn(padded))[:n, :n]
 

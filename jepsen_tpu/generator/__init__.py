@@ -30,7 +30,7 @@ import builtins
 import inspect
 import logging
 import random as _random
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 
 from .. import obs
 from ..util import secs_to_nanos
@@ -286,7 +286,7 @@ class FriendlyExceptions(Generator):
     def op(self, test, ctx):
         try:
             res = gen_op(self.gen, test, ctx)
-        except Exception as e:
+        except Exception as e:  # noqa: BLE001 - rethrown with context
             raise RuntimeError(
                 f"Generator threw {type(e).__name__} when asked for an "
                 f"operation. Generator: {self.gen!r}; context: {ctx!r}") \
@@ -300,7 +300,7 @@ class FriendlyExceptions(Generator):
         try:
             return FriendlyExceptions(
                 gen_update(self.gen, test, ctx, event))
-        except Exception as e:
+        except Exception as e:  # noqa: BLE001 - rethrown with context
             raise RuntimeError(
                 f"Generator threw {type(e).__name__} during update. "
                 f"Event: {event!r}; context: {ctx!r}") from e

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import heapq
 
-from . import (NEMESIS, PENDING, Context, context, fixed_rand, gen_op,
+from . import (PENDING, context, fixed_rand, gen_op,
                gen_update, validate)
 
 #: latency applied by the `perfect` completion functions: 10 ns

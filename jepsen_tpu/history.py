@@ -35,6 +35,19 @@ NIL = -(2**31)
 INF_TIME = np.iinfo(np.int64).max
 
 
+class HistoryError(ValueError):
+    """A structurally malformed history: the checkers' preconditions do
+    not hold, so any verdict computed from it would be meaningless.
+    Carries the offending ``process`` and event ``index`` when known;
+    ``analysis.histlint`` reports the same defects as diagnostics
+    without raising."""
+
+    def __init__(self, message, process=None, index=None):
+        super().__init__(message)
+        self.process = process
+        self.index = index
+
+
 class Op(dict):
     """An operation event: a dict with attribute access.
 
@@ -63,7 +76,7 @@ class Op(dict):
         return o
 
 
-def op(type=INVOKE, process=0, f=None, value=None, **kw) -> Op:
+def op(type=INVOKE, process=0, f=None, value=None, **kw) -> Op:  # noqa: A002,E501 - mirrors the reference op keys
     """Construct an op event."""
     o = Op(type=type, process=process, f=f, value=value)
     o.update(kw)
@@ -126,8 +139,16 @@ def index(history):
 
 
 def ensure_indexed(history):
-    """Index the history unless every event already carries an index."""
-    if all(isinstance(o, dict) and "index" in o for o in history):
+    """Index the history unless every event already carries an index.
+
+    Raises HistoryError (naming the offending position) on events that
+    are not mappings -- Op(non-dict) used to fail later with an opaque
+    ValueError from dict()."""
+    for i, o in enumerate(history):
+        if not isinstance(o, dict):
+            raise HistoryError(
+                f"history event #{i} is not a mapping: {o!r}", index=i)
+    if all("index" in o for o in history):
         return [o if isinstance(o, Op) else Op(o) for o in history]
     return index(history)
 
@@ -139,6 +160,11 @@ def pairs(history):
 
     Events pair by process: a completion matches the most recent open
     invocation on the same process.
+
+    Raises HistoryError on an invoke while the same process already has
+    an open invocation: processes are logically single-threaded, and
+    silently dropping the earlier invocation (the old behavior) changes
+    which ops the checker sees.
     """
     open_by_process = {}
     out = []
@@ -147,6 +173,15 @@ def pairs(history):
         t = o["type"]
         p = o["process"]
         if t == INVOKE:
+            if p in open_by_process:
+                prev = open_by_process[p]
+                raise HistoryError(
+                    f"process {p!r} invoked {o.get('f')!r} at index "
+                    f"{o.get('index', '?')} while its invocation of "
+                    f"{prev.get('f')!r} (index {prev.get('index', '?')})"
+                    " is still open: processes are logically "
+                    "single-threaded",
+                    process=p, index=o.get("index"))
             open_by_process[p] = o
             order.append(p)
         elif t in (OK, FAIL, INFO):

@@ -267,7 +267,8 @@ class _IndependentChecker(Checker):
 
             results = dict(bounded_pmap(one, ks))
 
-        failures = [k for k, r in results.items() if r.get("valid") is not True]
+        failures = [k for k, r in results.items()
+                    if r.get("valid") is not True]
         return {"valid": merge_valid([r.get("valid")
                                       for r in results.values()]),
                 "results": results,

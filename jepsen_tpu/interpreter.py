@@ -256,7 +256,7 @@ def _run(test):
                 history.append(op)
             outstanding += 1
             poll_timeout = 0.0
-    except BaseException:
+    except BaseException:  # noqa: BLE001 - workers must exit on ANY abort
         logger.info("Shutting down workers after abnormal exit")
         # drain inboxes and ask workers to exit
         for w in workers:

@@ -145,8 +145,9 @@ class ModelSpec:
         init_state np.int32[S])."""
         interner = Interner()
         enc = self.encode_op or self.default_encode_op
-        e = encode_history(hist, lambda f, v, rv: enc(self, interner, f, v, rv),
-                           self.arg_width)
+        e = encode_history(
+            hist, lambda f, v, rv: enc(self, interner, f, v, rv),
+            self.arg_width)
         s = self.state_size(e)
         return e, np.asarray(self.init_state(e, s), np.int32)
 
@@ -162,6 +163,8 @@ _REGISTRY = {}
 
 
 def register_model(spec: ModelSpec):
+    # codelint: ok -- import-time registration, serialized by Python's
+    # module import lock; never called from worker threads
     _REGISTRY[spec.name] = spec
     return spec
 

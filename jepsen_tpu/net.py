@@ -10,7 +10,6 @@ net.clj:101-111)."""
 from __future__ import annotations
 
 from . import control as c
-from .util import real_pmap
 
 
 class Net:

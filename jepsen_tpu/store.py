@@ -23,6 +23,7 @@ import os
 import os.path
 import pathlib
 import shutil
+import threading
 
 from . import history as h
 from .util import op_str
@@ -37,6 +38,7 @@ base_dir = "store"
 DEFAULT_NONSERIALIZABLE_KEYS = {
     "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
     "remote", "barrier", "sessions", "dummy-log", "obs",
+    "analysis-done?",
 }
 
 TIME_FORMAT = "%Y%m%dT%H%M%S.%f%z"
@@ -152,6 +154,7 @@ def write_test(test):
     t = dict(serializable_test(test))
     t.pop("history", None)   # stored separately as history.jsonl
     t.pop("results", None)   # stored separately as results.json
+    t.pop("analysis", None)  # stored separately as analysis.json
     _dump_json(t, make_path(test, "test.json"))
 
 
@@ -203,12 +206,23 @@ def write_obs(test):
         logger.warning("couldn't write obs artifacts", exc_info=True)
 
 
+def write_analysis(test):
+    """Writes analysis.json: the static-diagnostic reports accumulated
+    on the test map (planlint preflight, histlint) -- see
+    jepsen_tpu.analysis. No file is written for tests that never ran an
+    analyzer."""
+    report = test.get("analysis")
+    if report:
+        _dump_json(report, make_path(test, "analysis.json"))
+
+
 def save_1(test):
     """Phase 1: history + test map, right after the run and before analysis
     (store.clj:388-399). Returns test."""
     write_history(test)
     write_test(test)
     write_obs(test)
+    write_analysis(test)
     update_symlinks(test)
     return test
 
@@ -224,6 +238,7 @@ def save_2(test):
     write_results(test)
     write_history(test)
     write_test(test)
+    write_analysis(test)   # histlint findings exist only after analyze
     update_symlinks(test)
     return test
 
@@ -265,13 +280,21 @@ def load_results(test_name, test_time):
 
 
 _results_cache = {}
+_results_cache_lock = threading.Lock()
 
 
 def memoized_load_results(test_name, test_time):
+    """Cached load_results -- web handler threads hit this
+    concurrently, so the cache dict is locked (the disk read itself
+    runs outside the lock; a race loads twice, one result wins)."""
     key = (test_name, test_time)
-    if key not in _results_cache:
-        _results_cache[key] = load_results(test_name, test_time)
-    return _results_cache[key]
+    with _results_cache_lock:
+        if key in _results_cache:
+            return _results_cache[key]
+    results = load_results(test_name, test_time)
+    with _results_cache_lock:
+        _results_cache.setdefault(key, results)
+        return _results_cache[key]
 
 
 # ---------------------------------------------------------------------------
@@ -335,6 +358,8 @@ def delete(test_name=None, test_time=None):
 # per-test logging (store.clj:415-460)
 
 _log_handler = None
+# RLock: start_logging calls stop_logging under the same lock
+_log_lock = threading.RLock()
 
 LOG_PATTERN = "%(asctime)s\t%(levelname)s\t[%(threadName)s] %(name)s: " \
               "%(message)s"
@@ -356,28 +381,30 @@ def start_logging(test):
     current symlink (store.clj:431-452). :logging-json? selects JSON
     structured logs."""
     global _log_handler
-    stop_logging()
-    handler = logging.FileHandler(make_path(test, "jepsen.log"))
-    if test.get("logging-json?"):
-        handler.setFormatter(_JsonFormatter())
-    else:
-        handler.setFormatter(logging.Formatter(LOG_PATTERN))
-    overrides = (test.get("logging") or {}).get("overrides", {})
-    for pkg, level in overrides.items():
-        logging.getLogger(pkg).setLevel(
-            getattr(logging, str(level).upper(), logging.INFO))
-    root = logging.getLogger()
-    if root.level > logging.INFO or root.level == logging.NOTSET:
-        root.setLevel(logging.INFO)
-    root.addHandler(handler)
-    _log_handler = handler
+    with _log_lock:
+        stop_logging()
+        handler = logging.FileHandler(make_path(test, "jepsen.log"))
+        if test.get("logging-json?"):
+            handler.setFormatter(_JsonFormatter())
+        else:
+            handler.setFormatter(logging.Formatter(LOG_PATTERN))
+        overrides = (test.get("logging") or {}).get("overrides", {})
+        for pkg, level in overrides.items():
+            logging.getLogger(pkg).setLevel(
+                getattr(logging, str(level).upper(), logging.INFO))
+        root = logging.getLogger()
+        if root.level > logging.INFO or root.level == logging.NOTSET:
+            root.setLevel(logging.INFO)
+        root.addHandler(handler)
+        _log_handler = handler
     update_current_symlink(test)
 
 
 def stop_logging():
     """Removes the per-test log file handler (store.clj:453-460)."""
     global _log_handler
-    if _log_handler is not None:
-        logging.getLogger().removeHandler(_log_handler)
-        _log_handler.close()
-        _log_handler = None
+    with _log_lock:
+        if _log_handler is not None:
+            logging.getLogger().removeHandler(_log_handler)
+            _log_handler.close()
+            _log_handler = None

@@ -27,12 +27,15 @@ def with_relative_time():
     """Establish t=0 for relative_time_nanos (util.clj:328-347). The origin is
     global (all worker threads share it), mirroring the reference's var."""
     global _global_origin
+    # codelint: ok -- save/restore of one atomic reference, bound once
+    # per run by the single-threaded lifecycle before workers spawn
     prev = _global_origin
+    # codelint: ok -- see above
     _global_origin = _time.monotonic_ns()
     try:
         yield
     finally:
-        _global_origin = prev
+        _global_origin = prev  # codelint: ok -- see above
 
 
 def relative_time_nanos() -> int:

@@ -43,7 +43,7 @@ def _valid_class(valid):
 
 def _fast_tests():
     """Test rows from results.json headers only (web.clj:48-69), plus
-    which observability artifacts each run has on disk."""
+    which observability/analysis artifacts each run has on disk."""
     rows = []
     for name in store.test_names():
         for t in sorted(store.tests(name), reverse=True):
@@ -54,7 +54,8 @@ def _fast_tests():
             except (FileNotFoundError, json.JSONDecodeError):
                 valid = "incomplete"
             fake = {"name": name, "start-time": t}
-            obs_files = [f for f in ("trace.jsonl", "metrics.json")
+            obs_files = [f for f in ("trace.jsonl", "metrics.json",
+                                     "analysis.json")
                          if os.path.exists(store.path(fake, f))]
             rows.append({"name": name, "time": t, "valid": valid,
                          "obs": obs_files})

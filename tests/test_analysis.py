@@ -1,0 +1,507 @@
+"""Static-diagnostics subsystem tests: the shared Diagnostic
+model, histlint over corrupted histories (each defect class -> its
+code), planlint over broken plans, codelint over seeded thread-safety
+defects, the tools/lint.py driver's exit codes, and the core.run /
+checker / store / obs integration points."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from jepsen_tpu import analysis
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import core
+from jepsen_tpu import generator as gen
+from jepsen_tpu import history as h
+from jepsen_tpu import obs
+from jepsen_tpu import store
+from jepsen_tpu import tests as tst
+from jepsen_tpu.analysis import codelint, histlint, planlint
+from jepsen_tpu.checker import checkers as ck
+from jepsen_tpu.tests import Atom
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+
+
+def codes(diags):
+    return [d.code for d in diags]
+
+
+def error_codes(diags):
+    return [d.code for d in analysis.errors(diags)]
+
+
+# ---------------------------------------------------------------------------
+# diagnostics model
+
+def test_diagnostic_model_and_renderers():
+    d1 = analysis.diag("HL002", analysis.ERROR, "boom", "history[3]",
+                       "fix it")
+    d2 = analysis.diag("HL001", analysis.WARNING, "meh")
+    assert analysis.max_severity([d1, d2]) == "error"
+    assert analysis.max_severity([d2]) == "warning"
+    assert analysis.max_severity([]) is None
+    assert analysis.severity_counts([d1, d2]) == {
+        "error": 1, "warning": 1, "info": 0}
+    text = analysis.render_text([d2, d1], title="report:")
+    # worst first, code + location + hint all present
+    assert text.index("HL002") < text.index("HL001")
+    assert "history[3]" in text and "fix: fix it" in text
+    j = analysis.to_json([d1])
+    assert j["counts"]["error"] == 1
+    assert j["diagnostics"][0]["code"] == "HL002"
+    # round-trips through the store encoder
+    json.dumps(j)
+
+
+def test_run_analyzer_emits_obs_span_and_counter():
+    from jepsen_tpu.obs import Registry, Tracer
+    tr, reg = Tracer(), Registry()
+    with obs.bind(tr, reg):
+        out = analysis.run_analyzer(
+            "histlint", lambda: [analysis.diag("HL004", analysis.ERROR,
+                                               "x")])
+    assert codes(out) == ["HL004"]
+    names = {e.get("name") for e in tr.events()}
+    assert "analysis.histlint" in names
+    counters = reg.snapshot()["counters"]
+    assert counters[
+        "analysis.diagnostics{analyzer=histlint,severity=error}"] == 1
+
+
+# ---------------------------------------------------------------------------
+# histlint: each defect class -> its specific code
+
+def valid_history():
+    return h.parse_history_edn_like([
+        ("invoke", 0, "write", 1),
+        ("invoke", 1, "read", None),
+        ("ok", 0, "write", 1),
+        ("ok", 1, "read", 1),
+        ("invoke", 0, "cas", [1, 2]),
+        ("fail", 0, "cas", [1, 2]),
+        ("invoke", 1, "read", None),
+        ("info", 1, "read", None),
+    ])
+
+
+def test_histlint_clean_history():
+    assert histlint.lint_history(valid_history()) == []
+
+
+def test_histlint_dangling_invoke():
+    hist = valid_history()[:-1]   # drop the final info completion
+    diags = histlint.lint_history(hist)
+    assert codes(diags) == ["HL001"]
+    assert diags[0].severity == "warning"
+
+
+def test_histlint_overlapping_invocations():
+    hist = valid_history()
+    # process 0 invokes again while its cas (invoked at 4) is open
+    hist.insert(5, h.op("invoke", 0, "read", None))
+    diags = histlint.lint_history(h.index(hist))
+    assert "HL002" in error_codes(diags)
+
+
+def test_histlint_completion_without_invoke():
+    hist = h.index([h.op("ok", 3, "read", 7)])
+    assert error_codes(histlint.lint_history(hist)) == ["HL003"]
+    # ...but a bare nemesis info event is legal
+    nem = h.index([h.op("info", "nemesis", "start", None)])
+    assert histlint.lint_history(nem) == []
+
+
+def test_histlint_mismatched_completion_f():
+    hist = h.index([h.op("invoke", 0, "write", 1),
+                    h.op("ok", 0, "read", 1)])
+    assert error_codes(histlint.lint_history(hist)) == ["HL003"]
+
+
+def test_histlint_unknown_type():
+    hist = h.index([h.op("explode", 0, "read", None)])
+    assert error_codes(histlint.lint_history(hist)) == ["HL004"]
+
+
+def test_histlint_nonmonotonic_index():
+    hist = valid_history()
+    hist[3]["index"] = 1   # duplicate of an earlier index
+    diags = histlint.lint_history(hist)
+    assert "HL005" in error_codes(diags)
+
+
+def test_histlint_unknown_op_f():
+    diags = histlint.lint_history(
+        valid_history(), model_fs={"read", "write"})
+    # once per op (the invoke), not once per event of the pair
+    assert error_codes(diags) == ["HL006"]
+    assert "cas" in diags[0].message
+
+
+def test_histlint_missing_fields_and_non_mapping():
+    diags = histlint.lint_history(
+        [{"type": "invoke"}, 42, {"type": "ok", "process": None}])
+    assert error_codes(diags) == ["HL007", "HL007", "HL007"]
+
+
+def test_histlint_encoded_tensors():
+    from jepsen_tpu.models import base as mbase
+    spec = mbase.model_spec("cas-register")
+    e, _ = spec.encode(valid_history())
+    assert histlint.lint_encoded(e) == []
+    # corrupt: first row returns before it invokes
+    e.return_idx[0] = e.invoke_idx[0] - 1
+    assert "HL010" in codes(histlint.lint_encoded(e))
+    # corrupt: ok row never returns
+    e2, _ = spec.encode(valid_history())
+    e2.return_idx[e2.is_ok.argmax()] = h.INF_TIME
+    assert "HL012" in codes(histlint.lint_encoded(e2))
+    # corrupt: unsorted rows
+    e3, _ = spec.encode(valid_history())
+    e3.invoke_idx[0], e3.invoke_idx[1] = e3.invoke_idx[1], \
+        e3.invoke_idx[0]
+    assert "HL011" in codes(histlint.lint_encoded(e3))
+
+
+def test_model_op_set_walks_checkers():
+    checker = jchecker.compose({
+        "lin": ck.linearizable({"model": "cas-register"}),
+        "noop": jchecker.noop(),
+    })
+    fs = histlint.model_op_set({"checker": checker})
+    assert fs == {"read", "write", "cas"}
+    assert histlint.model_op_set({"checker": jchecker.noop()}) is None
+
+
+# ---------------------------------------------------------------------------
+# history hardening (satellite): HistoryError names process/index
+
+def test_pairs_raises_history_error_on_overlap():
+    hist = h.index([h.op("invoke", 2, "read", None),
+                    h.op("invoke", 2, "write", 1)])
+    with pytest.raises(h.HistoryError) as ei:
+        h.pairs(hist)
+    assert ei.value.process == 2
+    assert ei.value.index == 1
+    assert "single-threaded" in str(ei.value)
+
+
+def test_ensure_indexed_raises_on_non_mapping():
+    with pytest.raises(h.HistoryError) as ei:
+        h.ensure_indexed([h.op("invoke", 0, "read", None), "nope"])
+    assert ei.value.index == 1
+    assert "not a mapping" in str(ei.value)
+
+
+def test_checker_turns_malformed_history_into_unknown():
+    """A history that pairs() rejects must not crash check_safe: the
+    verdict degrades to unknown, and histlint has flagged HL002."""
+    hist = h.index([h.op("invoke", 0, "read", None),
+                    h.op("invoke", 0, "write", 1),
+                    h.op("ok", 0, "write", 1)])
+    test = {"checker": ck.linearizable({"model": "cas-register"})}
+    res = jchecker.check_safe(test["checker"], test, hist)
+    assert res["valid"] == "unknown"
+    report = test["analysis"]["history"]
+    assert any(d["code"] == "HL002"
+               for d in report["diagnostics"])
+
+
+# ---------------------------------------------------------------------------
+# planlint
+
+def good_plan(**kw):
+    t = tst.noop_test()
+    t["ssh"] = {"dummy?": True}
+    t.update(kw)
+    return core.prepare_test(t)
+
+
+def test_planlint_clean_plan():
+    assert analysis.errors(planlint.lint_plan(good_plan())) == []
+
+
+def test_planlint_missing_client():
+    t = good_plan()
+    del t["client"]
+    assert "PL001" in error_codes(planlint.lint_plan(t))
+
+
+def test_planlint_bad_nemesis_and_checker():
+    t = good_plan(nemesis=object())
+    assert "PL003" in error_codes(planlint.lint_plan(t))
+    t2 = good_plan(checker=object())
+    assert "PL004" in error_codes(planlint.lint_plan(t2))
+
+
+def test_planlint_bad_generator_type():
+    t = good_plan(generator=1234)
+    assert "PL005" in error_codes(planlint.lint_plan(t))
+
+
+def test_planlint_concurrency():
+    t = good_plan(concurrency=-3)
+    assert "PL006" in error_codes(planlint.lint_plan(t))
+    t2 = good_plan(concurrency=3)   # 5 nodes
+    assert "PL007" in codes(planlint.lint_plan(t2))
+
+
+def test_planlint_generator_op_outside_model():
+    t = good_plan(
+        checker=ck.linearizable({"model": "cas-register"}),
+        generator=gen.clients(gen.limit(3, gen.repeat(
+            {"f": "increment", "value": 1}))))
+    diags = planlint.lint_plan(t)
+    assert "PL008" in error_codes(diags)
+    # supported f's pass
+    t2 = good_plan(
+        checker=ck.linearizable({"model": "cas-register"}),
+        generator=gen.clients(gen.limit(3, gen.repeat({"f": "read"}))))
+    assert "PL008" not in codes(planlint.lint_plan(t2))
+
+
+def test_planlint_preflight_raises_on_fatal():
+    t = good_plan()
+    del t["client"]
+    with pytest.raises(planlint.PlanLintError) as ei:
+        planlint.preflight(t)
+    assert any(d.code == "PL001" for d in ei.value.diagnostics)
+
+
+def test_core_run_preflight_rejects_broken_plan():
+    t = good_plan(name="preflight-reject", generator=1234)
+    with pytest.raises(planlint.PlanLintError):
+        core.run(t)
+    # opt-out runs (and completes: generator 1234 is simply unusable,
+    # so use None instead to keep the run green)
+    t2 = good_plan(name="preflight-optout", generator=None)
+    t2["preflight?"] = False
+    done = core.run(t2)
+    assert "plan" not in (done.get("analysis") or {})
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a clean tier-1-style workload has zero error diagnostics,
+# analysis.json is persisted, and the web UI links it
+
+def test_clean_workload_run_zero_error_diagnostics():
+    state = Atom(None)
+    t = good_plan(
+        name="analysis-clean",
+        db=tst.atom_db(state),
+        client=tst.atom_client(state),
+        concurrency=4,
+        checker=ck.linearizable({"model": "cas-register",
+                                 "algorithm": "wgl",
+                                 "init-ops": [{"f": "write",
+                                               "value": 0}]}),
+        generator=gen.clients(gen.limit(30, gen.mix(
+            [gen.repeat({"f": "read"}),
+             gen.repeat({"f": "write", "value": 2})]))),
+    )
+    done = core.run(t)
+    assert done["results"]["valid"] is True
+    report = done["analysis"]["history"]
+    assert report["counts"]["error"] == 0
+    plan_report = done["analysis"]["plan"]
+    assert plan_report["counts"]["error"] == 0
+    # persisted next to results.json
+    p = store.path(done, "analysis.json")
+    assert os.path.exists(p)
+    with open(p) as f:
+        on_disk = json.load(f)
+    assert on_disk["history"]["counts"]["error"] == 0
+    # metrics carry the analyzer counters
+    with open(store.path(done, "metrics.json")) as f:
+        metrics = json.load(f)
+    assert any(k.startswith("analysis.run_s")
+               for k in metrics["histograms"])
+    assert any(k.startswith("analysis.diagnostics")
+               for k in metrics["counters"])
+    # the web home page links the analysis artifact
+    from jepsen_tpu import web
+    rows = web._fast_tests()
+    assert any("analysis.json" in r["obs"] for r in rows)
+
+
+def test_analysis_opt_out_per_test():
+    hist = valid_history()
+    test = {"analysis?": False,
+            "checker": jchecker.unbridled_optimism()}
+    jchecker.check_safe(test["checker"], test, hist)
+    assert "analysis" not in test
+
+
+def test_corrupted_workload_run_flags_errors():
+    """core.run on a history with a corrupt checker-visible structure:
+    the verdict is computed (checkers are fault-tolerant) but
+    analysis.json records the defect."""
+    hist = valid_history()
+    hist.insert(2, h.op("invoke", 0, "read", None))   # overlap on p0
+    test = {"name": "analysis-corrupt",
+            "start-time": store.local_time(),
+            "checker": jchecker.unbridled_optimism(),
+            "history": h.index(hist)}
+    core.analyze(test)
+    report = test["analysis"]["history"]
+    assert any(d["code"] == "HL002" for d in report["diagnostics"])
+    with open(store.path(test, "analysis.json")) as f:
+        assert json.load(f)["history"]["counts"]["error"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# codelint
+
+SEEDED_DEFECT = '''
+import threading
+
+_cache = {}
+_lock = threading.Lock()
+
+
+def worker(key, value):
+    _cache[key] = value          # unsynchronized!
+
+
+def safe(key, value):
+    with _lock:
+        _cache[key] = value
+
+
+def spawn():
+    threading.Thread(target=worker, args=(1, 2)).start()
+'''
+
+
+def test_codelint_catches_seeded_defect(tmp_path):
+    p = tmp_path / "defect.py"
+    p.write_text(SEEDED_DEFECT)
+    diags = codelint.lint_paths([str(p)])
+    assert error_codes(diags) == ["CL001"]
+    assert "defect.py:9" in diags[0].location
+
+
+def test_codelint_lock_and_pragma_suppression(tmp_path):
+    src = SEEDED_DEFECT.replace(
+        "_cache[key] = value          # unsynchronized!",
+        "_cache[key] = value          # codelint: ok -- test only")
+    p = tmp_path / "suppressed.py"
+    p.write_text(src)
+    assert codelint.lint_paths([str(p)]) == []
+
+
+def test_codelint_global_rebind_and_class_attr(tmp_path):
+    p = tmp_path / "more.py"
+    p.write_text('''
+_handle = None
+
+
+class Shared:
+    count = 0
+
+    def bump(self):
+        Shared.count += 1
+
+
+def set_handle(x):
+    global _handle
+    _handle = x
+''')
+    got = set(error_codes(codelint.lint_paths([str(p)])))
+    assert got == {"CL002", "CL003"}
+
+
+def test_codelint_local_shadowing_not_flagged(tmp_path):
+    p = tmp_path / "shadow.py"
+    p.write_text('''
+_cache = {}
+
+
+def fine():
+    _cache = {}          # a fresh local, not the module global
+    _cache["x"] = 1
+    return _cache
+''')
+    assert codelint.lint_paths([str(p)]) == []
+
+
+def test_codelint_shipped_tree_is_clean():
+    """Acceptance: zero error-severity findings on the shipped tree."""
+    diags = codelint.lint_paths(
+        [os.path.join(REPO, "jepsen_tpu")],
+        package_root=os.path.join(REPO, "jepsen_tpu"))
+    assert analysis.errors(diags) == [], \
+        analysis.render_text(diags)
+
+
+def test_threaded_reachability_ranks_modules():
+    import glob
+    files = glob.glob(os.path.join(REPO, "jepsen_tpu", "**", "*.py"),
+                      recursive=True)
+    reach = codelint.threaded_modules(files,
+                                      os.path.join(REPO, "jepsen_tpu"))
+    # thread spawners and their dependencies are in; leaf OS shims out
+    assert "jepsen_tpu.interpreter" in reach
+    assert "jepsen_tpu.history" in reach   # imported by checker path
+    assert "jepsen_tpu.os.centos" not in reach
+
+
+# ---------------------------------------------------------------------------
+# tools/lint.py driver
+
+def _run_lint(args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--no-ruff"] + args,
+        capture_output=True, text=True, timeout=120)
+
+
+def test_lint_tool_zero_on_shipped_tree():
+    r = _run_lint([os.path.join(REPO, "jepsen_tpu"),
+                   os.path.join(REPO, "tools")])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_lint_tool_nonzero_on_seeded_defect(tmp_path):
+    p = tmp_path / "defect.py"
+    p.write_text(SEEDED_DEFECT)
+    r = _run_lint([str(p)])
+    assert r.returncode == 1
+    assert "CL001" in r.stdout
+
+
+def test_lint_tool_json_output(tmp_path):
+    p = tmp_path / "defect.py"
+    p.write_text(SEEDED_DEFECT)
+    r = _run_lint(["--json", str(p)])
+    report = json.loads(r.stdout)
+    assert report["failed"] is True
+    assert report["counts"]["error"] == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI --lint dry run
+
+def test_cli_lint_dry_run(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu", "test", "--workload",
+         "noop", "--no-ssh", "--lint"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "plan lint" in r.stdout
+    # a dry run creates no store directory
+    assert not (tmp_path / "store").exists()

@@ -2,7 +2,6 @@
 (reference test/jepsen/fs_cache_test.clj + the nemesis/membership and
 charybdefs recipes)."""
 
-import os
 import threading
 import time as wall
 

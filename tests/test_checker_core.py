@@ -1,0 +1,152 @@
+"""checker.core edge cases: merge_valid /
+valid_prio over None/"unknown"/mixed inputs, check_safe's exception
+containment, and the once-per-test histlint hook's idempotence and
+containment."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import history as h
+from jepsen_tpu.checker.core import (check_safe, merge_valid,
+                                     valid_prio)
+
+
+def hist():
+    return h.parse_history_edn_like([
+        ("invoke", 0, "read", None),
+        ("ok", 0, "read", 1),
+    ])
+
+
+# ---------------------------------------------------------------------------
+# validity lattice
+
+def test_valid_prio_ordering():
+    assert valid_prio(False) == 0
+    assert valid_prio("unknown") == 1
+    assert valid_prio(None) == 1
+    assert valid_prio(True) == 2
+    # any other truthy value ranks like True (checker.clj's :else)
+    assert valid_prio("yep") == 2
+
+
+def test_merge_valid_lattice():
+    assert merge_valid([]) is True
+    assert merge_valid([True, True]) is True
+    assert merge_valid([True, "unknown"]) == "unknown"
+    assert merge_valid([None, True]) is None          # None ~ unknown
+    assert merge_valid(["unknown", False, True]) is False
+    assert merge_valid([False]) is False
+    # False dominates regardless of order
+    assert merge_valid([True, "unknown", False, None]) is False
+
+
+# ---------------------------------------------------------------------------
+# check_safe containment
+
+def test_check_safe_checker_raises_becomes_unknown():
+    def boom(test, hist_, opts):
+        raise RuntimeError("kaboom")
+
+    res = check_safe(boom, {}, hist())
+    assert res["valid"] == "unknown"
+    assert "kaboom" in res["error"]
+    assert "RuntimeError" in res["error"]
+
+
+def test_check_safe_passes_through_unknown_and_false():
+    assert check_safe(lambda t, hh, o: {"valid": "unknown"},
+                      {}, hist())["valid"] == "unknown"
+    assert check_safe(lambda t, hh, o: {"valid": False},
+                      {}, hist())["valid"] is False
+
+
+def test_check_safe_malformed_history_becomes_unknown():
+    """ensure_indexed raises HistoryError on junk events; check_safe
+    contains it."""
+    res = check_safe(jchecker.noop(), {}, ["not-an-op"])
+    assert res["valid"] == "unknown"
+    assert "HistoryError" in res["error"]
+
+
+def test_compose_merges_and_survives_a_raising_subchecker():
+    def boom(test, hist_, opts):
+        raise ValueError("sub-checker died")
+
+    c = jchecker.compose({
+        "good": jchecker.unbridled_optimism(),
+        "bad": boom,
+    })
+    res = check_safe(c, {}, hist())
+    assert res["valid"] == "unknown"
+    assert res["good"]["valid"] is True
+    assert res["bad"]["valid"] == "unknown"
+
+
+def test_compose_false_dominates_unknown():
+    c = jchecker.compose({
+        "f": lambda t, hh, o: {"valid": False},
+        "u": lambda t, hh, o: {"valid": "unknown"},
+        "t": jchecker.noop(),
+    })
+    assert check_safe(c, {}, hist())["valid"] is False
+
+
+# ---------------------------------------------------------------------------
+# the histlint hook
+
+def test_lint_runs_once_per_test_map():
+    test = {}
+    c = jchecker.compose({f"n{i}": jchecker.noop() for i in range(8)})
+    check_safe(c, test, hist())
+    # one report despite 8 subcheckers fanning through check()
+    assert test["analysis-done?"] is True
+    assert "history" in test["analysis"]
+    before = test["analysis"]["history"]
+    check_safe(c, test, hist())
+    assert test["analysis"]["history"] is before
+
+
+def test_lint_hook_is_thread_safe():
+    test = {}
+    barrier = threading.Barrier(8)
+    done = []
+
+    def worker():
+        barrier.wait()
+        check_safe(jchecker.noop(), test, hist())
+        done.append(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(done) == 8
+    assert "history" in test["analysis"]
+
+
+def test_lint_crash_never_changes_verdict(monkeypatch):
+    from jepsen_tpu import analysis
+
+    def explode(*a, **kw):
+        raise RuntimeError("lint bug")
+
+    monkeypatch.setattr(analysis, "run_analyzer", explode)
+    test = {}
+    res = check_safe(jchecker.unbridled_optimism(), test, hist())
+    assert res["valid"] is True
+
+
+def test_non_dict_test_is_tolerated():
+    res = check_safe(jchecker.unbridled_optimism(), None, hist())
+    assert res["valid"] is True
+
+
+@pytest.mark.parametrize("opt_out", [False, 0, None])
+def test_analysis_opt_out_values(opt_out):
+    test = {"analysis?": opt_out}
+    check_safe(jchecker.noop(), test, hist())
+    assert "analysis" not in test

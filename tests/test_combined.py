@@ -92,7 +92,8 @@ def test_grudge_specs():
     assert sizes == [2, 2, 2, 3, 3]   # 2-node side grudges 3, and vice versa
     gr = nc.grudge(test, db, "majorities-ring")
     for n in NODES:
-        assert len(NODES) - len(gr[n]) >= 3   # every node still sees a majority
+        # every node still sees a majority
+        assert len(NODES) - len(gr[n]) >= 3
     gp = nc.grudge(test, db, "primaries")
     assert any(len(v) >= 3 for v in gp.values())
     explicit = {"n1": {"n2"}}

@@ -3,7 +3,6 @@ cycle engine (reference: elle's documented anomaly taxonomy; jepsen's
 cycle workloads delegate there, cycle/append.clj:11-27)."""
 
 import numpy as np
-import pytest
 
 from jepsen_tpu import txn as t
 from jepsen_tpu.cycle import (RW, WR, WW, Graph, append as ap,

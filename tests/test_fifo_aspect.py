@@ -4,7 +4,6 @@ answers (it is used as an exact fast path, not a heuristic)."""
 
 import random
 
-import pytest
 
 from jepsen_tpu.checker import jax_wgl, wgl
 from jepsen_tpu.models import fifo_queue_spec

@@ -1,7 +1,6 @@
 """Interpreter tests with fake in-process clients (reference test level 2:
 test/jepsen/generator/interpreter_test.clj)."""
 
-import threading
 import time
 
 from jepsen_tpu import client as jc

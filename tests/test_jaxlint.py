@@ -1,0 +1,114 @@
+"""jaxlint tests: clean model steps lint clean; each hazard
+class -- captured constants, weak-typed scalars, host callbacks,
+untraceable steps, int32 index-width overflow -- is caught with its
+specific code."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from jepsen_tpu.analysis import jaxlint
+from jepsen_tpu.models import base as mbase
+import jepsen_tpu.models.registers  # noqa: F401 - registers specs
+import jepsen_tpu.models.mutex  # noqa: F401
+import jepsen_tpu.models.queues  # noqa: F401
+
+
+def codes(diags):
+    return [d.code for d in diags]
+
+
+def errors(diags):
+    return [d for d in diags if d.severity == "error"]
+
+
+# ---------------------------------------------------------------------------
+# the shipped model specs are hazard-free
+
+def test_shipped_model_steps_lint_clean():
+    for name in ("register", "cas-register", "mutex", "fifo-queue",
+                 "unordered-queue"):
+        spec = mbase.model_spec(name)
+        diags = jaxlint.lint_model_spec(spec)
+        assert errors(diags) == [], (name, codes(diags))
+
+
+# ---------------------------------------------------------------------------
+# seeded hazards
+
+def test_captured_constant_flags_jx002():
+    baked = np.arange(5000, dtype=np.int32)
+
+    def step(x):
+        return x + jnp.asarray(baked)
+
+    diags, _ = jaxlint.lint_fn(step, jnp.zeros(5000, jnp.int32))
+    assert "JX002" in codes(diags)
+
+
+def test_weak_typed_input_flags_jx001():
+    def f(x, bound):
+        return x + bound
+
+    # a Python int argument traces as a weak-typed scalar
+    diags, _ = jaxlint.lint_fn(f, jnp.zeros((4,), jnp.int32), 3)
+    assert "JX001" in codes(diags)
+    # an explicit dtype does not
+    diags2, _ = jaxlint.lint_fn(f, jnp.zeros((4,), jnp.int32),
+                                jnp.int32(3))
+    assert "JX001" not in codes(diags2)
+
+
+def test_host_callback_flags_jx003():
+    def step(x):
+        jax.debug.print("x={x}", x=x)
+        return x * 2
+
+    diags, _ = jaxlint.lint_fn(step, jnp.zeros((4,), jnp.int32))
+    assert "JX003" in codes(diags)
+    assert errors(diags)
+
+
+def test_untraceable_step_reported_not_raised():
+    def step(x):
+        if x[0] > 0:           # Python control flow on a traced value
+            return x
+        return -x
+
+    diags, closed = jaxlint.lint_fn(step, jnp.zeros((4,), jnp.int32))
+    assert closed is None
+    assert codes(diags) == ["JX000"]
+    assert "trace" in diags[0].message
+
+
+def test_wide_dtype_flags_jx006():
+    def step(x):
+        return x.astype(jnp.int64).sum()
+
+    # x64 is disabled by default: int64 silently becomes int32, so
+    # force-enable inside the test only
+    with jax.experimental.enable_x64():
+        diags, _ = jaxlint.lint_fn(step, jnp.zeros((4,), jnp.int32))
+    assert "JX006" in codes(diags)
+
+
+# ---------------------------------------------------------------------------
+# int32 index-width conformance
+
+def test_history_size_limits():
+    assert jaxlint.lint_history_size(10_000) == []
+    big = jaxlint.lint_history_size(2**28, arg_width=1)
+    assert codes(big) == ["JX005"]          # within 2x of the ceiling
+    over = jaxlint.lint_history_size(2**30, arg_width=1)
+    assert codes(over) == ["JX004"]
+    assert errors(over)
+    # the key axis multiplies cell count
+    keyed = jaxlint.lint_history_size(2**22, arg_width=1, keys=256)
+    assert codes(keyed) == ["JX004"]
+
+
+def test_search_plan_clean_at_tier1_scales():
+    spec = mbase.model_spec("cas-register")
+    assert jaxlint.lint_search_plan(
+        4096, S=2, arg_width=spec.arg_width) == []

@@ -3,7 +3,6 @@ caps that keep big-state searches from building multi-GB step tensors
 (a 9k-op FIFO probe crashed the TPU worker in the first BENCH_r04 run;
 see PROFILE.md round 4)."""
 
-import numpy as np
 
 from jepsen_tpu.checker import jax_wgl
 

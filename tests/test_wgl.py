@@ -9,7 +9,6 @@ import pytest
 from jepsen_tpu import history as h
 from jepsen_tpu import models as m
 from jepsen_tpu.checker import wgl
-from jepsen_tpu.history import INF_TIME
 
 
 def H(*rows):

@@ -4,7 +4,6 @@ adya}.clj)."""
 
 import random
 
-import pytest
 
 from jepsen_tpu import generator as gen
 from jepsen_tpu import independent

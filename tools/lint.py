@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Framework lint driver: codelint (the AST thread-safety pass) plus
+ruff (style/correctness), one exit code.
+
+Usage::
+
+    python tools/lint.py                 # lint jepsen_tpu/ tools/ tests/
+    python tools/lint.py path [path...]  # lint specific files/dirs
+    python tools/lint.py --json          # machine-readable diagnostics
+    python tools/lint.py --no-ruff       # codelint only
+
+Exit codes: 0 clean (warnings allowed), 1 error-severity codelint
+diagnostics or ruff violations, 2 internal error. ruff is optional at
+runtime (the container may not ship it); when absent it is skipped
+with a notice -- CI installs it, so the workflow gets both passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from jepsen_tpu import analysis  # noqa: E402
+from jepsen_tpu.analysis import codelint  # noqa: E402
+
+DEFAULT_PATHS = ("jepsen_tpu", "tools", "tests")
+
+
+def run_codelint(paths, package_root):
+    return analysis.run_analyzer(
+        "codelint", codelint.lint_paths, paths,
+        package_root=package_root)
+
+
+def ruff_argv():
+    """A usable ruff invocation, or None when ruff is unavailable."""
+    exe = shutil.which("ruff")
+    if exe:
+        return [exe]
+    try:
+        import ruff  # noqa: F401
+    except ImportError:
+        return None
+    return [sys.executable, "-m", "ruff"]
+
+
+def run_ruff(paths):
+    """Run ruff check; returns (exit_code, output) or (None, reason)."""
+    argv = ruff_argv()
+    if argv is None:
+        return None, "ruff not installed; skipping style pass"
+    proc = subprocess.run(argv + ["check", *paths], cwd=REPO,
+                          capture_output=True, text=True)
+    return proc.returncode, (proc.stdout + proc.stderr).strip()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: "
+                         f"{' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--json", action="store_true",
+                    help="emit diagnostics as JSON")
+    ap.add_argument("--no-ruff", action="store_true",
+                    help="skip the ruff style pass")
+    ap.add_argument("--package-root", default=None,
+                    help="package dir for thread-reachability ranking "
+                         "(default: jepsen_tpu when linted)")
+    opts = ap.parse_args(argv)
+
+    paths = list(opts.paths) or [os.path.join(REPO, p)
+                                 for p in DEFAULT_PATHS
+                                 if os.path.isdir(os.path.join(REPO, p))]
+    package_root = opts.package_root
+    if package_root is None:
+        for p in paths:
+            if os.path.basename(os.path.normpath(p)) == "jepsen_tpu":
+                package_root = p
+                break
+
+    diags = run_codelint(paths, package_root)
+    failed = bool(analysis.errors(diags))
+
+    ruff_code, ruff_out = (None, "skipped (--no-ruff)") if opts.no_ruff \
+        else run_ruff(paths)
+    if ruff_code not in (None, 0):
+        failed = True
+
+    if opts.json:
+        report = analysis.to_json(diags)
+        report["ruff"] = {"exit_code": ruff_code, "output": ruff_out}
+        report["failed"] = failed
+        print(json.dumps(report, indent=1))
+    else:
+        print(analysis.render_text(diags, title="codelint:"))
+        print(f"ruff: {ruff_out or 'clean'}"
+              if ruff_code in (None, 0)
+              else f"ruff FAILED (exit {ruff_code}):\n{ruff_out}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,9 @@ Codes:
                  set
   PL009 warning  a literal nemesis op's :f is not in ``nemesis.fs()``
   PL010 warning  non-positive time-limit / test-count
+  PL011 warning  robustness knobs inconsistent: non-positive
+                 op-timeout-ms / time-limit-s / abort-grace-s, or a
+                 per-op timeout at or beyond the whole-run deadline
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -192,6 +195,35 @@ def lint_plan(test):
                 "PL010", WARNING,
                 f"{key} should be a positive number, got {v!r}",
                 f"plan.{key}"))
+
+    # -- robustness knobs (jepsen_tpu.robust) --------------------------
+    def _num(key):
+        v = test.get(key)
+        if v is None:
+            return None
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or v <= 0:
+            diags.append(diag(
+                "PL011", WARNING,
+                f"{key} should be a positive number, got {v!r} "
+                "(non-positive values disable the feature, probably "
+                "unintentionally)",
+                f"plan.{key}"))
+            return None
+        return v
+
+    op_timeout_ms = _num("op-timeout-ms")
+    time_limit_s = _num("time-limit-s")
+    _num("abort-grace-s")
+    if op_timeout_ms is not None and time_limit_s is not None \
+            and op_timeout_ms >= time_limit_s * 1000:
+        diags.append(diag(
+            "PL011", WARNING,
+            f"op-timeout-ms {op_timeout_ms} >= time-limit-s "
+            f"{time_limit_s} ({time_limit_s * 1000:g} ms): the "
+            "wedged-worker watchdog can never fire before the whole-run "
+            "deadline aborts the test",
+            "plan.op-timeout-ms"))
     return diags
 
 

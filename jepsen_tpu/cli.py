@@ -63,6 +63,22 @@ def add_test_opts(parser):
                         metavar="SECONDS",
                         help="How long the test runs, excluding setup and "
                              "teardown.")
+    parser.add_argument("--op-timeout-ms", type=float, default=None,
+                        metavar="MS",
+                        help="Wedged-worker watchdog: ops blocking past "
+                             "this deadline complete as :info "
+                             "harness-timeout and their worker is "
+                             "replaced (default: off).")
+    parser.add_argument("--hard-time-limit", type=float, default=None,
+                        metavar="SECONDS",
+                        help="Hard harness deadline: abort gracefully, "
+                             "salvage and check the partial history "
+                             "(default: off).")
+    parser.add_argument("--abort-grace", type=float, default=None,
+                        metavar="SECONDS",
+                        help="How long outstanding ops may drain after "
+                             "an abort (SIGINT/SIGTERM/hard deadline) "
+                             "before being written off as :info.")
     parser.add_argument("--lint", action="store_true",
                         help="Dry run: statically validate the test plan "
                              "(planlint) and exit without contacting any "
@@ -114,6 +130,15 @@ def test_opt_fn(opts):
     opts["leave-db-running?"] = opts.pop("leave-db-running", False)
     opts["logging-json?"] = opts.pop("logging-json", False)
     opts["lint?"] = opts.pop("lint", False)
+    # robustness knobs (jepsen_tpu.robust): map CLI names onto the test
+    # keys core.run/interpreter watch; absent flags leave the keys out
+    # entirely so the features stay off
+    for flag, key in (("op-timeout-ms", "op-timeout-ms"),
+                      ("hard-time-limit", "time-limit-s"),
+                      ("abort-grace", "abort-grace-s")):
+        v = opts.pop(flag, None)
+        if v is not None:
+            opts[key] = v
     opts.pop("node", None)
     opts.pop("nodes-file", None)
     return opts

@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import subprocess
 
+from ..robust import RetryPolicy
 from .core import Remote, env_string, wrap_cd, wrap_sudo
 
 logger = logging.getLogger(__name__)
@@ -240,21 +241,41 @@ class DummyRemote(Remote):
         return {"exit": 0}
 
 
+def transport_failed(result):
+    """Did a subprocess transport fail at the *transport* layer?
+
+    ``_run`` reports failure as a result dict, not an exception -- ssh
+    exits 255 for its own errors (vs the remote command's exit code),
+    and a subprocess timeout becomes ``{"exit": -1, "err": "timeout"}``
+    -- so an exception-only retry loop never sees these. This is the
+    retry predicate `RetryRemote` feeds to `robust.RetryPolicy`."""
+    return isinstance(result, dict) and (
+        result.get("exit") == 255
+        or (result.get("exit") == -1 and result.get("err") == "timeout"))
+
+
 class RetryRemote(Remote):
     """Wraps a remote with bounded retry + reconnect: "SSH client libraries
-    appear to be near universally-flaky" (control/retry.clj:1-22 -- 5
-    tries, ~100 ms backoff)."""
+    appear to be near universally-flaky" (control/retry.clj:1-22).
 
-    TRIES = 5
-    BACKOFF_S = 0.1
+    Retries both raised exceptions AND failed-transport result dicts
+    (see `transport_failed`) on the unified `robust.RetryPolicy`
+    backoff; after each failed attempt the underlying connection is
+    re-established. On exhaustion the last result dict is returned (or
+    the last exception re-raised) so callers see the real failure."""
 
-    def __init__(self, remote, conn_spec=None):
+    POLICY = RetryPolicy(tries=5, base_s=0.1, multiplier=2.0,
+                         jitter=0.1, max_backoff_s=2.0,
+                         max_elapsed_s=60.0)
+
+    def __init__(self, remote, conn_spec=None, policy=None):
         self.remote = remote
         self.conn_spec = conn_spec
         self.conn = None
+        self.policy = policy or self.POLICY
 
     def connect(self, conn_spec):
-        r = RetryRemote(self.remote, conn_spec)
+        r = RetryRemote(self.remote, conn_spec, policy=self.policy)
         r.conn = self.remote.connect(conn_spec)
         return r
 
@@ -262,20 +283,25 @@ class RetryRemote(Remote):
         if self.conn is not None:
             self.conn.disconnect()
 
+    def _reconnect(self, attempt, exc):
+        # loud on purpose: a remote command whose OWN exit status is 255
+        # is indistinguishable from an ssh transport error here, and the
+        # retry RE-EXECUTES the command -- non-idempotent actions should
+        # not exit 255 (or should bypass RetryRemote)
+        logger.warning(
+            "remote attempt %d failed (%s); reconnecting and "
+            "RE-EXECUTING the command", attempt + 1,
+            exc if exc is not None else "transport-failure result")
+        try:
+            self.conn = self.remote.connect(self.conn_spec)
+        except Exception:  # noqa: BLE001 - retry loop handles it
+            pass
+
     def _with_retry(self, f):
-        import time
-        last = None
-        for _ in range(self.TRIES):
-            try:
-                return f()
-            except Exception as e:  # noqa: BLE001 - flaky transports
-                last = e
-                time.sleep(self.BACKOFF_S)
-                try:
-                    self.conn = self.remote.connect(self.conn_spec)
-                except Exception:  # noqa: BLE001
-                    pass
-        raise last
+        return self.policy.call(
+            f, retry_on_exception=Exception,
+            retry_on_result=transport_failed,
+            on_retry=self._reconnect, site="control.retry_remote")
 
     def execute(self, ctx, action):
         return self._with_retry(lambda: self.conn.execute(ctx, action))

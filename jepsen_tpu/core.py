@@ -24,6 +24,7 @@ from . import db as jdb
 from . import history as jhistory
 from . import nemesis as jnemesis
 from . import obs
+from . import robust
 from . import store
 from . import util
 from . import interpreter
@@ -67,6 +68,23 @@ class _Barrier:
             return True
         except threading.BrokenBarrierError:
             return False
+
+    def reset(self):
+        """Un-poison the barrier. A wait timeout breaks a
+        threading.Barrier *permanently* -- every later wait fails
+        instantly -- so retry loops (db.cycle) must reset between
+        attempts, once all parties have unwound from the broken
+        round."""
+        self._barrier.reset()
+
+
+def reset_barrier(test):
+    """Reset the test's setup barrier if it is resettable (db.cycle
+    calls this between setup retries; see _Barrier.reset)."""
+    barrier = test.get("barrier")
+    reset = getattr(barrier, "reset", None)
+    if callable(reset):
+        reset()
 
 
 def primary(test):
@@ -273,16 +291,53 @@ def run_case(test):
 
 def analyze(test):
     """Index the history, run the checker, save results
-    (core.clj:221-236)."""
+    (core.clj:221-236). Salvaged runs (abort mid-run: the history is a
+    prefix, not the full plan) are checked all the same, with
+    ``results["salvaged"] = True`` so readers know the verdict covers
+    only what was collected."""
     logger.info("Analyzing...")
     with obs.span("analyze"):
         test["history"] = jhistory.index(test.get("history") or [])
         test["results"] = jchecker.check_safe(
             test.get("checker") or jchecker.noop(), test, test["history"])
+    if test.get("salvaged?") or test.get("aborted"):
+        results = test["results"]
+        if isinstance(results, dict):
+            results["salvaged"] = True
+            if test.get("aborted"):
+                results["abort-reason"] = str(test["aborted"])
     logger.info("Analysis complete")
     if test.get("name"):
         store.save_2(test)
     return test
+
+
+def salvage(test, cause):
+    """Best-effort persistence + analysis of a partial history after an
+    abnormal abort (hard signal, nemesis crash, BarrierTimeout...).
+
+    The interpreter leaves the live history list on
+    ``test["partial-history"]``; ``run`` calls this before re-raising so
+    the history-so-far is persisted, *checked*, and marked
+    ``results["salvaged"] = True`` instead of discarded. Never raises:
+    salvage must not mask the abort's root cause."""
+    hist = test.pop("partial-history", None)
+    if not hist:
+        return False
+    test["history"] = hist
+    test["salvaged?"] = True
+    test.setdefault("aborted", repr(cause))
+    logger.warning("Salvaging partial history (%d ops) after abort: %r",
+                   len(hist), cause)
+    obs.inc("robust.salvages")
+    try:
+        if test.get("name"):
+            store.save_1(test)
+        analyze(test)
+    except Exception:  # noqa: BLE001 - best-effort, root cause wins
+        logger.warning("Error while salvaging partial history:\n%s",
+                       traceback.format_exc())
+    return True
 
 
 def log_results(test):
@@ -348,6 +403,18 @@ def run(test):
       name         test name (enables the store directory)
       leave-db-running?  skip DB teardown at the end
 
+    Fault-tolerance knobs (jepsen_tpu.robust; all optional):
+
+      op-timeout-ms   wedged-worker watchdog deadline per op
+      time-limit-s    hard harness deadline -> graceful abort
+      abort-grace-s   drain window for outstanding ops on abort
+
+    SIGINT/SIGTERM abort gracefully (second signal hard-aborts), and on
+    ANY abort the partial history is persisted, checked, and marked
+    ``results["salvaged"] = True`` rather than discarded; named tests
+    additionally journal every op to ``history.jsonl.journal`` so even
+    SIGKILL leaves the history on disk.
+
     Lifecycle (core.clj:326-397): prepare -> logging -> sessions -> os ->
     db (+log snarfing) -> relative time -> run-case -> save-1 -> analyze
     (save-2) -> log-results."""
@@ -360,14 +427,32 @@ def run(test):
                     # plan preflight: fail fast on wiring defects,
                     # before sessions/OS/DB touch any node
                     preflight(test)
-                    with with_sessions(test):
-                        with with_os(test):
-                            with with_db(test):
-                                with util.ensure_relative_time():
-                                    test["history"] = run_case(test)
-                        # sessions still open: snarfing happened inside
-                        # with_db
+                    latch = test.setdefault("abort",
+                                            robust.AbortLatch())
+                    try:
+                        with robust.signal_scope(latch):
+                            with with_sessions(test):
+                                with with_os(test):
+                                    with with_db(test):
+                                        with util.ensure_relative_time():
+                                            if test.get("name"):
+                                                test["journal"] = \
+                                                    store.open_journal(
+                                                        test)
+                                            test["history"] = \
+                                                run_case(test)
+                            # sessions still open: snarfing happened
+                            # inside with_db
+                    except BaseException as e:
+                        salvage(test, e)
+                        raise
+                    finally:
+                        journal = test.pop("journal", None)
+                        if journal is not None:
+                            journal.close()
                     test.pop("barrier", None)
+                    if test.get("aborted"):
+                        test["salvaged?"] = True
                     logger.info("Run complete, writing")
                     if test.get("name"):
                         store.save_1(test)
@@ -384,4 +469,5 @@ def run(test):
             if test.get("name") and test.get("obs"):
                 store.write_obs(test)
             test.pop("obs", None)
+            test.pop("abort", None)
     return test

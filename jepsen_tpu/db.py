@@ -15,6 +15,7 @@ import time
 
 from . import control as c
 from .control import util as cu
+from .robust import RetryPolicy
 
 logger = logging.getLogger(__name__)
 
@@ -84,29 +85,41 @@ class SetupFailed(Exception):
 #: How many tries do we get to set up a database? (db.clj:117-119)
 CYCLE_TRIES = 3
 
+#: Unified backoff for setup retries (robust.RetryPolicy); module-level
+#: so tests can patch the sleeps away.
+CYCLE_RETRY_POLICY = RetryPolicy(tries=CYCLE_TRIES, base_s=0.25,
+                                 multiplier=2.0, jitter=0.1,
+                                 max_backoff_s=10.0)
+
 
 def cycle(test):
     """Tears down, then sets up, the database on all nodes concurrently.
     If setup (or primary setup) raises SetupFailed, tear down and retry the
-    whole process up to CYCLE_TRIES times (db.clj:121-158)."""
+    whole process up to CYCLE_TRIES times on the CYCLE_RETRY_POLICY
+    backoff (db.clj:121-158). The setup barrier is reset between
+    attempts: a BarrierTimeout poisons threading.Barrier permanently, so
+    without the reset every retry's first synchronize would fail
+    instantly."""
     db = test["db"]
-    tries = CYCLE_TRIES
-    while True:
+
+    def attempt():
         logger.info("Tearing down DB")
         c.on_nodes(test, db.teardown)
-        try:
-            logger.info("Setting up DB")
-            c.on_nodes(test, db.setup)
-            if isinstance(db, Primary):
-                primary = test["nodes"][0]
-                logger.info("Setting up primary %s", primary)
-                c.on_nodes(test, db.setup_primary, [primary])
-            return
-        except SetupFailed:
-            tries -= 1
-            if tries < 1:
-                raise
-            logger.warning("Unable to set up database; retrying...")
+        logger.info("Setting up DB")
+        c.on_nodes(test, db.setup)
+        if isinstance(db, Primary):
+            primary = test["nodes"][0]
+            logger.info("Setting up primary %s", primary)
+            c.on_nodes(test, db.setup_primary, [primary])
+
+    def on_retry(_attempt, _exc):
+        logger.warning("Unable to set up database; retrying...")
+        from . import core
+        core.reset_barrier(test)
+
+    return CYCLE_RETRY_POLICY.call(
+        attempt, retry_on_exception=SetupFailed, on_retry=on_retry,
+        site="db.cycle")
 
 
 class Tcpdump(DB, LogFiles):

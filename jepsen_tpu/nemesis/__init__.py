@@ -134,8 +134,13 @@ class Timeout(Nemesis):
     def invoke(self, test, op):
         fallback = dict(op)
         fallback["value"] = "timeout"
-        return timeout_call(self.timeout_ms, fallback,
-                            self.nemesis.invoke, test, op)
+        out = timeout_call(self.timeout_ms, fallback,
+                           self.nemesis.invoke, test, op)
+        if out is fallback:
+            # the abandoned invoke thread is already counted by
+            # timeout_call; this separates nemesis timeouts in metrics
+            obs.inc("nemesis.timeouts", f=str(op.get("f")))
+        return out
 
     def teardown(self, test):
         self.nemesis.teardown(test)

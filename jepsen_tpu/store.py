@@ -38,8 +38,12 @@ base_dir = "store"
 DEFAULT_NONSERIALIZABLE_KEYS = {
     "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
     "remote", "barrier", "sessions", "dummy-log", "obs",
-    "analysis-done?",
+    "analysis-done?", "abort", "journal", "partial-history",
 }
+
+#: on-disk name of the incremental history journal (one JSON op per
+#: line, appended as the run progresses; finalized into history.jsonl)
+JOURNAL_FILE = "history.jsonl.journal"
 
 TIME_FORMAT = "%Y%m%dT%H%M%S.%f%z"
 
@@ -138,14 +142,70 @@ def write_results(test):
 
 def write_history(test):
     """Writes history.txt (human) and history.jsonl (machine)
-    (store.clj:360-371)."""
+    (store.clj:360-371). history.jsonl lands via atomic rename, and a
+    successful write retires the incremental journal (the journal is
+    crash insurance; once the real file exists it is strictly
+    better)."""
     hist = test.get("history") or []
     with open(make_path(test, "history.txt"), "w") as f:
         for op in hist:
             f.write(op_str(op) + "\n")
-    with open(make_path(test, "history.jsonl"), "w") as f:
+    p = make_path(test, "history.jsonl")
+    tmp = p + ".tmp"
+    with open(tmp, "w") as f:
         for op in hist:
             f.write(json.dumps(op, cls=_Encoder) + "\n")
+    os.replace(tmp, p)
+    journal = test.get("journal")
+    if journal is not None:
+        journal.close()
+    try:
+        os.remove(path(test, JOURNAL_FILE))
+    except OSError:
+        pass
+
+
+class HistoryJournal:
+    """Crash-only incremental history: every op is appended (one JSON
+    line) and flushed as it lands in the interpreter's history, so a
+    SIGKILL'd run still leaves ``history.jsonl.journal`` on disk with
+    everything up to the kill. ``write_history`` finalizes: once the
+    atomic ``history.jsonl`` exists the journal is deleted.
+    ``load_history`` falls back to the journal when only it survives.
+
+    Appends happen on the interpreter's event-loop thread only; close
+    is idempotent and append-after-close is a silent no-op (abort
+    paths race teardown)."""
+
+    def __init__(self, journal_path):
+        self.path = journal_path
+        self._f = open(journal_path, "a")
+
+    def append(self, op):
+        f = self._f
+        if f is None:
+            return
+        try:
+            f.write(json.dumps(op, cls=_Encoder) + "\n")
+            f.flush()
+        except (OSError, ValueError):  # disk full / closed underfoot
+            logger.warning("history journal append failed",
+                           exc_info=True)
+            self._f = None
+
+    def close(self):
+        f, self._f = self._f, None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:  # pragma: no cover
+                pass
+
+
+def open_journal(test):
+    """An appendable HistoryJournal in the test's store directory
+    (core.run parks it on ``test["journal"]`` for the interpreter)."""
+    return HistoryJournal(make_path(test, JOURNAL_FILE))
 
 
 def write_test(test):
@@ -261,15 +321,30 @@ def load(test_name, test_time):
 
 
 def load_history(test):
-    hist = []
-    try:
-        with open(path(test, "history.jsonl")) as f:
-            for line in f:
-                if line.strip():
-                    hist.append(h.Op(json.loads(line)))
-    except FileNotFoundError:
-        pass
-    return hist
+    """Loads history.jsonl; falls back to the incremental journal when
+    only it survived (SIGKILL before finalize). A torn final journal
+    line (killed mid-append) is dropped rather than fatal."""
+    for name, salvaging in (("history.jsonl", False),
+                            (JOURNAL_FILE, True)):
+        hist = []
+        try:
+            with open(path(test, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        hist.append(h.Op(json.loads(line)))
+                    except ValueError:
+                        if salvaging:
+                            logger.warning(
+                                "dropping torn journal line in %s", name)
+                            continue
+                        raise
+            return hist
+        except FileNotFoundError:
+            continue
+    return []
 
 
 def load_results(test_name, test_time):

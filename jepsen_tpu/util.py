@@ -127,16 +127,36 @@ class Timeout(Exception):
 def timeout_call(ms, timeout_val, f, *args):
     """Run f in a thread; if it exceeds ms milliseconds return timeout_val
     (the thread is abandoned, like the reference's future cancellation --
-    util.clj timeout macro)."""
-    ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-    fut = ex.submit(f, *args)
-    try:
-        return fut.result(timeout=ms / 1000.0)
-    except concurrent.futures.TimeoutError:
-        fut.cancel()
+    util.clj timeout macro).
+
+    Abandoned threads are not silent: they are renamed to
+    ``jepsen abandoned <f>`` (so a thread dump attributes them) and
+    counted in the ``robust.threads_abandoned`` obs counter, landing in
+    metrics.json next to the interpreter's leaked-worker totals."""
+    from . import obs
+    name = getattr(f, "__name__", None) or repr(f)
+    box = {}
+    done = threading.Event()
+    ctx = contextvars.copy_context()
+
+    def call():
+        try:
+            box["ok"] = ctx.run(f, *args)
+        except BaseException as e:  # noqa: BLE001 - rethrown by caller
+            box["err"] = e
+        finally:
+            done.set()
+
+    thread = threading.Thread(target=call, name=f"jepsen timeout {name}",
+                              daemon=True)
+    thread.start()
+    if not done.wait(ms / 1000.0):
+        thread.name = f"jepsen abandoned {name}"
+        obs.inc("robust.threads_abandoned", f=name)
         return timeout_val
-    finally:
-        ex.shutdown(wait=False)
+    if "err" in box:
+        raise box["err"]
+    return box["ok"]
 
 
 def rand_nth(seq, rng=random):

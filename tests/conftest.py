@@ -2,8 +2,19 @@
 logic is exercised without TPU hardware (the driver separately dry-runs the
 multi-chip path; bench.py runs on the real chip)."""
 
+import faulthandler
 import os
 import sys
+
+# Crash-only test harness: if the suite ever wedges (a regression in the
+# interpreter's shutdown paths, a deadlocked barrier), dump every
+# thread's stack and exit instead of silently eating the CI budget --
+# the tier-1 `timeout 870` would kill us stackless otherwise. Override
+# with JEPSEN_PYTEST_TIMEOUT_S (0 disables).
+faulthandler.enable()
+_budget = float(os.environ.get("JEPSEN_PYTEST_TIMEOUT_S", "820"))
+if _budget > 0:
+    faulthandler.dump_traceback_later(_budget, exit=True)
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env pins the TPU
 flags = os.environ.get("XLA_FLAGS", "")

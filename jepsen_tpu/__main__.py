@@ -2,7 +2,9 @@
 (reference cli.clj -main, extended with the demo workload registry).
 
     python -m jepsen_tpu test --workload register --no-ssh
-    python -m jepsen_tpu test-all --no-ssh
+    python -m jepsen_tpu test-all --no-ssh --parallel 2
+    python -m jepsen_tpu campaign --no-ssh \\
+        --axis workload=register,bank --seeds 3 --parallel 4
     python -m jepsen_tpu serve -p 8080
 """
 
@@ -43,6 +45,10 @@ def main(argv=None):
     }))
     subcommands.update(cli.test_all_cmd({
         "tests-fn": _tests_fn,
+        "opt-spec": _add_demo_opts,
+    }))
+    subcommands.update(cli.campaign_cmd({
+        "test-fn": demo.demo_test,
         "opt-spec": _add_demo_opts,
     }))
     subcommands.update(cli.serve_cmd())

@@ -25,10 +25,16 @@ Codes:
   PL011 warning  robustness knobs inconsistent: non-positive
                  op-timeout-ms / time-limit-s / abort-grace-s, or a
                  per-op timeout at or beyond the whole-run deadline
+  PL012 mixed    campaign matrix invalid: empty matrix / empty axis or
+                 duplicate cell ids (errors); seed collisions or
+                 per-cell robustness knobs that trip the PL011 rules
+                 (warnings)
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
-False``); everything else is logged and recorded.
+False``); everything else is logged and recorded. ``lint_campaign``
+is the campaign planner's pass (jepsen_tpu/campaign/plan.py) over an
+expanded sweep matrix.
 """
 
 from __future__ import annotations
@@ -40,7 +46,8 @@ from .histlint import model_op_set
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["lint_plan", "preflight", "PlanLintError", "FATAL_CODES"]
+__all__ = ["lint_plan", "lint_campaign", "preflight", "PlanLintError",
+           "FATAL_CODES"]
 
 #: error codes certain enough to abort the run before node contact
 FATAL_CODES = {"PL001", "PL003", "PL004", "PL005", "PL006"}
@@ -197,18 +204,29 @@ def lint_plan(test):
                 f"plan.{key}"))
 
     # -- robustness knobs (jepsen_tpu.robust) --------------------------
+    diags += robustness_knob_diags(test, "PL011", "plan")
+    return diags
+
+
+def robustness_knob_diags(params, code, where):
+    """The PL011 numeric rules over one params mapping, emitted under
+    ``code`` at location prefix ``where`` -- shared by the per-test
+    preflight (PL011) and the campaign matrix pass (PL012, which runs
+    them per expanded cell)."""
+    diags = []
+
     def _num(key):
-        v = test.get(key)
+        v = params.get(key)
         if v is None:
             return None
         if not isinstance(v, (int, float)) or isinstance(v, bool) \
                 or v <= 0:
             diags.append(diag(
-                "PL011", WARNING,
+                code, WARNING,
                 f"{key} should be a positive number, got {v!r} "
                 "(non-positive values disable the feature, probably "
                 "unintentionally)",
-                f"plan.{key}"))
+                f"{where}.{key}"))
             return None
         return v
 
@@ -218,12 +236,69 @@ def lint_plan(test):
     if op_timeout_ms is not None and time_limit_s is not None \
             and op_timeout_ms >= time_limit_s * 1000:
         diags.append(diag(
-            "PL011", WARNING,
+            code, WARNING,
             f"op-timeout-ms {op_timeout_ms} >= time-limit-s "
             f"{time_limit_s} ({time_limit_s * 1000:g} ms): the "
             "wedged-worker watchdog can never fire before the whole-run "
             "deadline aborts the test",
-            "plan.op-timeout-ms"))
+            f"{where}.op-timeout-ms"))
+    return diags
+
+
+def lint_campaign(matrix, cells):
+    """PL012: validate an expanded campaign sweep (campaign/plan.py
+    hands in the normalized matrix plus its expansion). Errors:
+    empty matrix / empty axis, duplicate cell ids. Warnings: seed
+    collisions in the seed axis, and per-cell robustness knobs that
+    trip the PL011 rules (reported per offending cell, capped)."""
+    diags = []
+    axes = (matrix or {}).get("axes") or {}
+    if not axes:
+        return [diag("PL012", ERROR,
+                     "campaign matrix has no axes: nothing to run",
+                     "campaign.axes",
+                     "give at least one axis (or a seeds count)")]
+    for name, values in axes.items():
+        if not values:
+            diags.append(diag(
+                "PL012", ERROR,
+                f"campaign axis {name!r} has no values",
+                f"campaign.axes.{name}"))
+    seeds = axes.get("seed")
+    if seeds is not None and len(set(map(repr, seeds))) < len(seeds):
+        diags.append(diag(
+            "PL012", WARNING,
+            f"seed axis has colliding values {seeds!r}: duplicate "
+            "seeds rerun identical cells and break flake attribution",
+            "campaign.axes.seed"))
+    seen, dups = set(), []
+    for cell in cells:
+        cid = cell.get("id")
+        if cid in seen:
+            dups.append(cid)
+        seen.add(cid)
+    if dups:
+        diags.append(diag(
+            "PL012", ERROR,
+            f"duplicate cell id(s) {sorted(set(dups))}: axis values "
+            "collapse to the same id, so the journal cannot tell the "
+            "cells apart",
+            "campaign.axes",
+            "make axis values distinct after id sanitization"))
+    knob_hits = 0
+    for cell in cells:
+        cell_diags = robustness_knob_diags(
+            cell.get("params") or {}, "PL012",
+            f"campaign.cell[{cell.get('id')}]")
+        if cell_diags and knob_hits < 8:
+            diags += cell_diags
+        knob_hits += bool(cell_diags)
+    if knob_hits > 8:
+        diags.append(diag(
+            "PL012", WARNING,
+            f"{knob_hits - 8} further cell(s) with inconsistent "
+            "robustness knobs suppressed",
+            "campaign.cells"))
     return diags
 
 

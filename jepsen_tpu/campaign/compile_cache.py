@@ -1,0 +1,137 @@
+"""Cross-run compile reuse: shape-bucket bookkeeping for the device
+checker.
+
+The WGL search engine already pads every encoded history to
+power-of-two shape buckets (checker/jax_wgl.py ``_bucket`` /
+``_plan_sizes``) precisely so that jax's jit cache is keyed by the
+*bucket*, not the raw history: two cells whose histories land in the
+same bucket reuse one compiled search. What a single run can't see is
+whether that reuse actually happened across a campaign -- an XLA
+recompile is silent, and on CPU it can dwarf the search itself.
+
+This module is the campaign-level ledger. The engines report every
+search's *plan key* (spec name + all compile-relevant sizes) here;
+the first sighting of a key is a **miss** (a fresh trace+compile), any
+later sighting is a **hit** (the jit cache served it). Counters are
+process-wide (the jit cache is too) and mirrored into whatever `obs`
+registry is bound at the moment, so each cell's ``metrics.json``
+carries its own hit/miss deltas while `stats()` feeds the campaign
+report.
+
+``n_floor`` is the tuning knob the campaign scheduler exposes: raising
+the minimum op-count bucket (default 64) coarsens the buckets so a
+sweep whose cells straddle a power of two -- e.g. histories of 900 and
+1100 ops, which would otherwise compile 1024- and 2048-buckets -- all
+share one shape. Padding rows are inert by construction (they can
+never become search candidates), so a larger floor trades a little
+per-iteration device work for one compile across the whole sweep.
+
+Deliberately dependency-light (obs only): checker.jax_wgl imports this
+lazily from inside the search entry points, and nothing here may drag
+the scheduler -> core -> checker import chain back in.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from .. import obs
+
+__all__ = ["bucket", "note", "stats", "reset", "n_floor", "set_n_floor",
+           "bucket_floor", "DEFAULT_N_FLOOR"]
+
+#: default minimum op-count bucket (matches jax_wgl's historical 64)
+DEFAULT_N_FLOOR = 64
+
+_lock = threading.Lock()
+_seen: set = set()
+_hits: dict = {}          # engine -> int
+_misses: dict = {}        # engine -> int
+_n_floor = DEFAULT_N_FLOOR
+
+
+def bucket(x, lo=1):
+    """Round up to a power of two (>= lo): the shared shape-bucket rule
+    (same math as checker.jax_wgl._bucket, restated here so callers
+    can predict which cells will share a compile)."""
+    return max(lo, 1 << (max(1, int(x)) - 1).bit_length())
+
+
+def n_floor():
+    """Current minimum op-count bucket for the device search."""
+    with _lock:
+        return _n_floor
+
+
+def set_n_floor(n):
+    """Set the minimum op-count bucket (>= 1). Process-wide: affects
+    every search planned afterwards."""
+    global _n_floor
+    with _lock:
+        _n_floor = max(1, int(n))
+
+
+@contextlib.contextmanager
+def bucket_floor(n):
+    """Scoped ``set_n_floor``: restore the previous floor on exit."""
+    prev = n_floor()
+    set_n_floor(n)
+    try:
+        yield
+    finally:
+        set_n_floor(prev)
+
+
+def note(engine, key):
+    """Record one search's compile plan. ``key`` must contain every
+    value that feeds the engine's jit cache key (spec name + plan
+    sizes). Returns True on a hit (a shape-identical search already
+    ran in this process, so the jit cache served the compile), False
+    on a miss. Mirrored to the bound obs registry as
+    ``campaign.compile_cache.{hits,misses}{engine=...}``."""
+    k = (str(engine), tuple(key))
+    with _lock:
+        hit = k in _seen
+        if hit:
+            _hits[engine] = _hits.get(engine, 0) + 1
+        else:
+            _seen.add(k)
+            _misses[engine] = _misses.get(engine, 0) + 1
+    obs.inc("campaign.compile_cache.hits" if hit
+            else "campaign.compile_cache.misses", engine=str(engine))
+    return hit
+
+
+def stats():
+    """Process-lifetime totals: {"hits", "misses", "shapes",
+    "by_engine": {engine: {"hits", "misses"}}}."""
+    with _lock:
+        engines = sorted(set(_hits) | set(_misses))
+        return {
+            "hits": sum(_hits.values()),
+            "misses": sum(_misses.values()),
+            "shapes": len(_seen),
+            "by_engine": {e: {"hits": _hits.get(e, 0),
+                              "misses": _misses.get(e, 0)}
+                          for e in engines},
+        }
+
+
+def delta(before):
+    """Stats since a prior ``stats()`` snapshot -- the campaign
+    scheduler brackets its run with this to report only its own cells'
+    reuse."""
+    now = stats()
+    return {"hits": now["hits"] - before.get("hits", 0),
+            "misses": now["misses"] - before.get("misses", 0)}
+
+
+def reset():
+    """Forget everything (tests). Does NOT touch jax's jit cache --
+    after a reset the first sighting of a still-compiled shape counts
+    as a miss even though the compile is skipped."""
+    with _lock:
+        _seen.clear()
+        _hits.clear()
+        _misses.clear()

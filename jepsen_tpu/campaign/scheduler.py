@@ -1,0 +1,299 @@
+"""The campaign scheduler: a bounded, resumable fleet of test cells.
+
+Execution model:
+
+* **Worker pool.** Cells run on ``parallel`` threads. The CPU-side
+  harness phases (db setup, generator, interpreter) of different cells
+  overlap freely -- that is where wall clock goes in a sweep of short
+  tests.
+* **Device slots.** Each cell's checker is wrapped so the expensive
+  check phase -- the device WGL search -- holds one of
+  ``device_slots`` semaphore slots. One accelerator gets one slot so
+  searches serialize instead of fighting over HBM; sharded checkers
+  (parallel/keyshard) or CPU-only sweeps can raise it.
+* **Abort latch.** The whole campaign shares one
+  ``robust.AbortLatch``, wired to SIGINT/SIGTERM on the main thread
+  and injected as every cell's ``test["abort"]``: the first signal
+  stops new cells AND gracefully drains the running ones (their
+  partial histories are salvaged and checked by the normal robust
+  machinery); a second signal hard-aborts. Either way the journal is
+  left resumable.
+* **Journal.** Every finished cell is appended to ``cells.jsonl``
+  (flush+fsync) the moment it completes; ``resume=True`` skips cells
+  whose latest record is terminal and re-runs aborted/missing ones.
+* **Telemetry.** The scheduler keeps its OWN Tracer/Registry (per-cell
+  spans, outcome counters, wall/wait histograms) dumped into the
+  campaign directory -- deliberately not the process-global `obs`
+  binding, which cells rebind per run (overlapping core.runs
+  cross-attribute the global pair; instance handles don't). Compile
+  reuse is bracketed via compile_cache.stats() deltas.
+
+Cells are ``{"id": str, "test": <test map>}`` or ``{"id": str,
+"build": callable(params) -> test map, "params": {...}}``; lazy builds
+keep a malformed cell's crash contained to that cell.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+
+from .. import core, robust, store
+from ..checker import core as checker_core
+from ..obs import Registry, Tracer
+from . import compile_cache
+from . import report as creport
+from .journal import CampaignJournal
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CampaignError", "run_cells", "new_campaign_id"]
+
+
+class CampaignError(RuntimeError):
+    """Campaign-level wiring failure (bad resume target, no cells)."""
+
+
+def new_campaign_id():
+    return "campaign-" + store.local_time()
+
+
+_stamp_lock = threading.Lock()
+_stamps = set()
+
+
+def _unique_start_time(name):
+    """A start-time stamp no other cell of this process holds for the
+    same test name. The store path is base_dir/<name>/<start-time>;
+    same-workload cells share a name, and two pool threads stamping in
+    the same microsecond would silently share (and corrupt) one run
+    directory."""
+    import datetime
+    with _stamp_lock:
+        t = datetime.datetime.now().astimezone()
+        while (name, store.local_time(t)) in _stamps:
+            t += datetime.timedelta(microseconds=1)
+        stamp = store.local_time(t)
+        _stamps.add((name, stamp))
+        return stamp
+
+
+class _DeviceSlotChecker(checker_core.Checker):
+    """Serializes the check phase through the campaign's device-slot
+    semaphore; the wait is observed so a slot-starved campaign is
+    visible in metrics rather than just slow."""
+
+    def __init__(self, inner, sem, reg):
+        self.inner = checker_core.as_checker(inner)
+        # keep the wrapped checker's name: spans/metrics must read
+        # "jax-wgl"/"Compose", not the wrapper class, so campaign and
+        # single-run telemetry stay comparable
+        self.name = checker_core.checker_name(self.inner)
+        self.sem = sem
+        self.reg = reg
+
+    def check(self, test, hist, opts=None):
+        t0 = time.monotonic()
+        with self.sem:
+            self.reg.observe("campaign.device_wait_s",
+                             time.monotonic() - t0)
+            return self.inner.check(test, hist, opts or {})
+
+
+def _outcome_of(test, latch):
+    """(outcome, valid): test_all-compatible outcomes plus "aborted"
+    for CAMPAIGN-latched runs (their salvaged verdict covers only a
+    prefix because the sweep was interrupted, so resume runs them
+    again). A cell that aborted on its OWN deadline (per-cell
+    ``time-limit-s`` sets ``test["aborted"] = "time-limit"`` with no
+    latch involved) ran exactly as planned: it keeps its decided
+    outcome, or resume would re-run it to the same deadline forever."""
+    valid = (test.get("results") or {}).get("valid")
+    if test.get("aborted") and latch.is_set() \
+            and str(test["aborted"]) == str(latch.reason):
+        return "aborted", valid
+    if valid is True or valid is False:
+        return valid, valid
+    return "unknown", valid
+
+
+def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
+              resume=False, latch=None, run_fn=None):
+    """Run a campaign; returns the aggregated report dict (also
+    persisted as report.json in the campaign directory).
+
+    ``resume=True`` requires an existing campaign: pass its id, or
+    leave ``campaign_id`` None to pick the most recently touched one.
+    """
+    cells = list(cells)
+    ids = [c["id"] for c in cells]
+    if len(set(ids)) != len(ids):
+        raise CampaignError(f"duplicate cell ids: "
+                            f"{sorted({i for i in ids if ids.count(i) > 1})}")
+    run_fn = run_fn or core.run
+    if resume and campaign_id is None:
+        campaign_id = store.latest_campaign()
+        if campaign_id is None:
+            raise CampaignError("--resume: no campaign found in the store")
+    campaign_id = campaign_id or new_campaign_id()
+    jr = CampaignJournal(campaign_id)
+    prior = jr.load_meta()
+    if resume and prior is None:
+        raise CampaignError(f"--resume: campaign {campaign_id!r} was "
+                            "never started")
+    if prior is not None and not resume:
+        # starting fresh over an existing journal would append a second
+        # run's records onto the first's (duplicate rows, counts off)
+        raise CampaignError(
+            f"campaign {campaign_id!r} already exists: pass --resume "
+            "to continue it, or pick a new --campaign-id")
+    done = jr.completed() if resume else {}
+    if resume:
+        # compare EVERY journaled cell (terminal or aborted) against
+        # the plan: a stale non-terminal record for a cell the matrix
+        # no longer contains would otherwise poison the final report
+        # and exit code forever
+        unknown = {r.get("cell") for r in jr.records()} - set(ids)
+        if unknown:
+            raise CampaignError(
+                f"--resume: journal has cells not in this plan "
+                f"{sorted(unknown)} -- same campaign id, different "
+                "matrix?")
+    jr.write_meta({
+        "status": "running",
+        "created": (prior or {}).get("created") or store.local_time(),
+        "updated": store.local_time(),
+        "cells": ids,
+        "parallel": parallel,
+        "device-slots": device_slots,
+        "resumes": ((prior or {}).get("resumes") or 0) + (1 if resume
+                                                          else 0),
+    })
+
+    latch = latch or robust.AbortLatch()
+    sem = threading.BoundedSemaphore(max(1, int(device_slots)))
+    tr, reg = Tracer(), Registry()
+    cc_before = compile_cache.stats()
+    pending = [c for c in cells if c["id"] not in done]
+    reg.set_gauge("campaign.cells_total", len(cells))
+    reg.set_gauge("campaign.cells_resumed", len(done))
+    if done:
+        logger.info("campaign %s: resuming, %d/%d cells already done",
+                    campaign_id, len(done), len(cells))
+
+    def run_one(cell):
+        if latch.is_set():
+            return None          # never started: no record, resume runs it
+        cid = cell["id"]
+        t0 = time.monotonic()
+        rec = {"cell": cid, "group": cell.get("group") or cid,
+               "params": cell.get("params") or {}}
+        test = None
+        with tr.span("campaign.cell", cat="campaign",
+                     args={"cell": cid}):
+            try:
+                build = cell.get("build")
+                test = build(cell.get("params") or {}) if build \
+                    else cell["test"]
+                if isinstance(test, dict) and test.get("name") \
+                        and not test.get("start-time"):
+                    test["start-time"] = _unique_start_time(
+                        str(test["name"]))
+                test = core.prepare_test(test)
+                test.setdefault("campaign", {}).update(
+                    {"id": campaign_id, "cell": cid,
+                     "params": cell.get("params") or {}})
+                test["abort"] = latch
+                if test.get("checker") is not None:
+                    test["checker"] = _DeviceSlotChecker(
+                        test["checker"], sem, reg)
+                finished = run_fn(test)
+                outcome, valid = _outcome_of(finished, latch)
+                rec["outcome"], rec["valid"] = outcome, valid
+                if finished.get("aborted"):
+                    rec["abort-reason"] = str(finished["aborted"])
+                err = (finished.get("results") or {}).get("error")
+                if err:
+                    rec["error"] = str(err)
+            except Exception:  # noqa: BLE001 - contained per cell
+                logger.warning("campaign cell %s crashed\n%s", cid,
+                               traceback.format_exc())
+                rec["outcome"] = "crashed"
+                rec["error"] = traceback.format_exc(limit=8)
+        try:
+            rec["path"] = store.path(test) if test else None
+        except (AssertionError, AttributeError, KeyError, TypeError):
+            # a crashed build may have left a non-test on `test`; path
+            # recovery must never take down the campaign loop
+            rec["path"] = None
+        rec["wall_s"] = round(time.monotonic() - t0, 3)
+        jr.append_cell(rec)
+        reg.inc("campaign.cells", outcome=str(rec["outcome"]))
+        reg.observe("campaign.cell_s", rec["wall_s"])
+        return rec
+
+    hard_abort = None
+    try:
+        with robust.signal_scope(latch):
+            with tr.span("campaign.run", cat="campaign",
+                         args={"id": campaign_id,
+                               "cells": len(pending)}):
+                if parallel <= 1:
+                    for cell in pending:
+                        run_one(cell)
+                else:
+                    pool = ThreadPoolExecutor(
+                        max_workers=int(parallel),
+                        thread_name_prefix="jepsen campaign")
+                    try:
+                        for f in [pool.submit(run_one, c)
+                                  for c in pending]:
+                            f.result()
+                        pool.shutdown(wait=True)
+                    except BaseException:
+                        # hard abort (second SIGINT raises
+                        # KeyboardInterrupt in the main thread): stop
+                        # waiting HERE so finalize below runs and the
+                        # exception propagates promptly. Pool threads
+                        # are non-daemon — a plain interpreter exit
+                        # still joins any cell wedged past the latch
+                        # drain; the CLI is immune because hard_main
+                        # exits via os._exit once artifacts are down
+                        pool.shutdown(wait=False, cancel_futures=True)
+                        raise
+    except BaseException as e:  # noqa: BLE001 - finalize, then rethrow
+        hard_abort = e
+        if not latch.is_set():
+            latch.set(repr(e))
+        logger.warning("campaign %s hard-aborted (%r); journal is "
+                       "resumable with --resume", campaign_id, e)
+
+    cc = compile_cache.delta(cc_before)
+    reg.set_gauge("campaign.compile_cache.hits", cc["hits"])
+    reg.set_gauge("campaign.compile_cache.misses", cc["misses"])
+    aborted = latch.is_set()
+    # the journal is the source of truth, latest record per cell: on a
+    # hard abort, pool threads may have journaled cells whose futures
+    # were never drained
+    report = creport.summarize(
+        jr.latest(),
+        meta={"id": campaign_id}, compile_cache=cc, aborted=aborted,
+        abort_reason=latch.reason, skipped=len(done))
+    jr.write_report(report)
+    try:
+        tr.dump(store.campaign_path(campaign_id, "trace.jsonl"))
+        store._dump_json(reg.snapshot(),
+                         store.campaign_path(campaign_id,
+                                             "metrics.json"))
+    except Exception:  # noqa: BLE001 - telemetry is a byproduct
+        logger.warning("couldn't write campaign obs artifacts",
+                       exc_info=True)
+    jr.write_meta({**(jr.load_meta() or {}),
+                   "status": "aborted" if aborted else "complete",
+                   "updated": store.local_time()})
+    if hard_abort is not None:
+        raise hard_abort
+    return report

@@ -887,6 +887,25 @@ def _bucket(x, lo):
     return max(lo, 1 << (int(x) - 1).bit_length())
 
 
+def _n_floor():
+    """Minimum op-count bucket. Campaigns raise it
+    (campaign.compile_cache.set_n_floor) so sweep cells whose op
+    counts straddle a power of two still share one compiled search;
+    padding rows are inert, so a coarser bucket is always sound."""
+    from ..campaign import compile_cache
+    return compile_cache.n_floor()
+
+
+def _note_compile(engine, key):
+    """Report this search's compile plan to the campaign-level
+    compile-reuse ledger (hit/miss counters; never verdict-bearing)."""
+    try:
+        from ..campaign import compile_cache
+        compile_cache.note(engine, key)
+    except Exception:  # noqa: BLE001 - telemetry only
+        pass
+
+
 def _adapt_quantum(cap, per_it, target_s, left_s=None):
     """Next dispatch quantum (shared by the single-key and batched
     loops): ~``target_s`` of measured per-iteration wall, capped by the
@@ -1111,7 +1130,7 @@ def _prepare_search(spec, e, init_state, confirm=False):
     # Pad shapes to power-of-two buckets so the compiled search is reused.
     # Padding rows are never candidates: they "invoke" after every finite
     # return (invoke INF32-1 >= any reachable r_min) and are not ok ops.
-    n_pad = _bucket(n, 64)
+    n_pad = _bucket(n, _n_floor())
     C = min(_bucket(C, 4), n_pad)
     if n_pad > n:
         pn = n_pad - n
@@ -1161,6 +1180,11 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
 
     B, W, O, T = _plan_sizes(n_pad, S, C, frontier_width, stack_size,
                              table_size)
+    # cross-run compile-reuse ledger: everything feeding _build_search's
+    # lru/jit key must feed this key too, or a "hit" could lie
+    _note_compile("jax-wgl", (spec.name, n_pad, B, S, C, A, W, O, T,
+                              rollout_kernel, rollout_seeds,
+                              rollout_depth))
     # honor tiny explicit budgets (a 1-iteration run must bail after 1
     # iteration, not 64 -- the checkpoint tests rely on it); the default
     # 50M-config budget keeps max_iters far above any real search

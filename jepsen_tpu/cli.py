@@ -217,12 +217,18 @@ def single_test_cmd(opts):
 
 
 def test_all_run_tests(tests):
-    """Run tests; map of outcome (True/False/'unknown'/'crashed') to store
-    paths (cli.clj:429-445)."""
+    """Run tests; map of outcome (True/False/'unknown'/'crashed') to
+    entries (cli.clj:429-445). Entries are store paths, or
+    {"cell": ..., "path": ...} dicts for campaign cells so sweep output
+    stays attributable. prepare_test runs INSIDE the try: one malformed
+    test plan records as "crashed" instead of taking the suite down."""
     results = {}
     for test in tests:
-        test = core.prepare_test(test)
+        cell = None
         try:
+            test = core.prepare_test(test)
+            cell = (test.get("campaign") or {}).get("cell") \
+                if isinstance(test.get("campaign"), dict) else None
             done = core.run(test)
             outcome = (done.get("results") or {}).get("valid")
             if outcome is not True and outcome is not False:
@@ -232,55 +238,262 @@ def test_all_run_tests(tests):
             outcome = "crashed"
         try:
             path = store.path(test)
-        except AssertionError:
+        except (AssertionError, AttributeError, KeyError, TypeError):
             path = "<unnamed>"
-        results.setdefault(outcome, []).append(path)
+        entry = {"cell": cell, "path": path} if cell else path
+        results.setdefault(outcome, []).append(entry)
     return results
 
 
+def _entry_str(entry):
+    """Render one outcome-group entry: plain path, or cell-id-tagged
+    path for campaign cells."""
+    if isinstance(entry, dict):
+        cell = entry.get("cell")
+        path = entry.get("path") or "<unnamed>"
+        return f"[{cell}] {path}" if cell else str(path)
+    return str(entry)
+
+
+def _result_group(results, key):
+    """Entries for one outcome group. Accepts both key spellings:
+    test_all_run_tests builds bool-keyed maps (reference shape), while
+    campaign report.results_map uses str() keys so the map survives a
+    report.json round trip."""
+    return results.get(key) or results.get(str(key)) or []
+
+
 def test_all_print_summary(results):
-    """Print outcome groups + counts (cli.clj:447-476)."""
+    """Print outcome groups + counts (cli.clj:447-476). Campaign cells
+    print with their cell ids so sweep output is attributable."""
     for title, key in (("Successful tests", True),
                        ("Indeterminate tests", "unknown"),
+                       ("Aborted tests", "aborted"),
                        ("Crashed tests", "crashed"),
                        ("Failed tests", False)):
-        if results.get(key):
+        group = _result_group(results, key)
+        if group:
             print(f"\n# {title}\n")
-            for p in results[key]:
-                print(p)
+            for p in group:
+                print(_entry_str(p))
     print()
-    print(len(results.get(True, [])), "successes")
-    print(len(results.get("unknown", [])), "unknown")
-    print(len(results.get("crashed", [])), "crashed")
-    print(len(results.get(False, [])), "failures")
+    print(len(_result_group(results, True)), "successes")
+    print(len(_result_group(results, "unknown"))
+          + len(_result_group(results, "aborted")), "unknown")
+    print(len(_result_group(results, "crashed")), "crashed")
+    print(len(_result_group(results, False)), "failures")
     return results
 
 
 def test_all_exit_code(results):
-    """255 crashed > 2 unknown > 1 failed > 0 (cli.clj:478-485)."""
-    if results.get("crashed"):
+    """255 crashed > 2 unknown > 1 failed > 0 (cli.clj:478-485).
+    Aborted campaign cells have no verdict, so they rank with
+    unknown."""
+    if _result_group(results, "crashed"):
         return 255
-    if results.get("unknown"):
+    if _result_group(results, "unknown") \
+            or _result_group(results, "aborted"):
         return 2
-    if results.get(False):
+    if _result_group(results, False):
         return 1
     return 0
 
 
+def campaign_exit_code(report):
+    """Exit code for a whole campaign. An aborted campaign ranks as
+    indeterminate (2) even when every *recorded* cell passed -- a
+    SIGINT landing between cells leaves the unrun cells with no
+    journal record at all, so the results map alone under-reports.
+    Crashed cells still dominate (255)."""
+    code = test_all_exit_code(report.get("results") or {})
+    if report.get("status") == "aborted" and code in (0, 1):
+        code = 2
+    return code
+
+
+def _add_campaign_opts(parser, axes=False):
+    parser.add_argument("--parallel", type=int, default=1, metavar="N",
+                        help="Worker-pool width: how many test cells "
+                             "run concurrently (campaign scheduler).")
+    parser.add_argument("--device-slots", type=int, default=1,
+                        metavar="N",
+                        help="How many device checker searches may run "
+                             "at once (one per accelerator).")
+    parser.add_argument("--campaign-id", default=None, metavar="ID",
+                        help="Campaign id (store/campaigns/<id>/); "
+                             "default: derived from the start time.")
+    parser.add_argument("--resume", action="store_true",
+                        help="Resume a campaign: skip cells whose "
+                             "outcome is already journaled; without "
+                             "--campaign-id, the most recent campaign "
+                             "is resumed.")
+    if axes:
+        parser.add_argument("--axis", action="append", default=[],
+                            metavar="NAME=V1,V2,...",
+                            help="A sweep axis: option NAME takes each "
+                                 "listed value (repeatable; numeric "
+                                 "values are coerced).")
+        parser.add_argument("--seeds", type=int, default=None,
+                            metavar="N",
+                            help="Shorthand for --axis "
+                                 "seed=0,1,...,N-1.")
+
+
 def test_all_cmd(opts):
     """Subcommand ``test-all``: run a suite of tests
-    (cli.clj:487-515). opts: {"tests-fn": options -> [test maps], ...}."""
+    (cli.clj:487-515). opts: {"tests-fn": options -> [test maps], ...}.
+
+    ``--parallel N`` / ``--resume`` route the suite through the
+    campaign scheduler (jepsen_tpu.campaign): each test becomes a cell,
+    outcomes journal to store/campaigns/<id>/, and a rerun with
+    --resume skips completed cells."""
     tests_fn = opts["tests-fn"]
 
+    def add_opts(parser):
+        _add_campaign_opts(parser)
+        if opts.get("opt-spec"):
+            opts["opt-spec"](parser)
+
     def run_all(options):
+        # ANY campaign flag routes through the scheduler -- a
+        # --campaign-id or --device-slots on the legacy sequential path
+        # would be silently ignored (no journal, nothing to resume)
+        if options.get("parallel", 1) > 1 or options.get("resume") \
+                or options.get("campaign-id") \
+                or (options.get("device-slots") or 1) > 1:
+            from . import campaign
+            cells, seen = [], {}
+            for i, t in enumerate(tests_fn(options)):
+                cid = str(t.get("name") or f"test-{i}")
+                seen[cid] = seen.get(cid, 0) + 1
+                if seen[cid] > 1:
+                    cid = f"{cid}#{seen[cid]}"
+                cells.append({"id": cid, "test": t})
+            try:
+                report = campaign.run_cells(
+                    cells, parallel=options.get("parallel", 1),
+                    device_slots=options.get("device-slots", 1),
+                    campaign_id=options.get("campaign-id"),
+                    resume=bool(options.get("resume")))
+            except campaign.CampaignError as e:
+                raise CliError(str(e)) from e
+            print(campaign.report.render_text(report))
+            test_all_print_summary(report["results"])
+            sys.exit(campaign_exit_code(report))
         results = test_all_run_tests(tests_fn(options))
         test_all_print_summary(results)
         sys.exit(test_all_exit_code(results))
 
-    return {"test-all": {"opt-spec": opts.get("opt-spec"),
+    return {"test-all": {"opt-spec": add_opts,
                          "opt-fn": opts.get("opt-fn"),
                          "run": run_all,
                          "help": "Run a whole suite of tests."}}
+
+
+def _coerce_axis_value(v):
+    try:
+        return int(v)
+    except ValueError:
+        try:
+            return float(v)
+        except ValueError:
+            return v
+
+
+def parse_axes(specs, seeds=None):
+    """--axis NAME=V1,V2 specs -> {name: [values]}; ``seeds`` adds the
+    seed axis."""
+    axes = {}
+    for spec in specs or []:
+        name, eq, values = str(spec).partition("=")
+        if not eq or not name:
+            raise CliError(f"--axis {spec!r} should be NAME=V1,V2,...")
+        axes[name] = [_coerce_axis_value(v)
+                      for v in values.split(",") if v != ""]
+    if seeds:
+        axes.setdefault("seed", list(range(int(seeds))))
+    return axes
+
+
+def campaign_cmd(opts):
+    """Subcommand ``campaign``: expand a sweep matrix over the suite's
+    test-fn and run it as a parallel, resumable campaign. opts:
+    {"test-fn": options -> test map, "opt-spec": fn(parser),
+    "opt-fn": fn(options)}.
+
+        python -m jepsen_tpu campaign --no-ssh \\
+            --axis workload=register,bank --seeds 3 --parallel 4
+
+    Axis names are option keys: each cell rebuilds the test map from
+    the base options with that cell's axis values overlaid (a ``seed``
+    axis also seeds the global RNG before the build). ``--lint`` dry
+    runs the PL012 matrix validation and prints the cell ids."""
+    test_fn = opts["test-fn"]
+
+    def add_opts(parser):
+        _add_campaign_opts(parser, axes=True)
+        if opts.get("opt-spec"):
+            opts["opt-spec"](parser)
+
+    def run_campaign(options):
+        import random
+        import threading
+
+        from . import campaign
+        from . import analysis
+        axes = parse_axes(options.get("axis"), options.get("seeds"))
+        matrix = {"axes": axes}
+        cells_plan = campaign.plan.expand(matrix)
+        diags = campaign.plan.lint(matrix)
+        if options.get("lint?"):
+            print(analysis.render_text(diags, title="campaign lint:"))
+            for c in cells_plan:
+                print(c["id"])
+            sys.exit(1 if analysis.errors(diags) else 0)
+        if analysis.errors(diags):
+            raise CliError(analysis.render_text(
+                diags, title="campaign matrix invalid:"))
+
+        # seed + build are one atomic step: scheduler pool threads
+        # build cells concurrently, and the global RNG must not be
+        # re-seeded by a sibling cell mid-build. (Draws during the RUN
+        # still interleave between parallel cells; seeds reproduce
+        # fully only at --parallel 1 -- see doc/campaign.md.)
+        build_lock = threading.Lock()
+
+        def build(params):
+            o = dict(options)
+            o.update(params)
+            # axis values land AFTER test_opt_fn already ran, so
+            # option syntaxes that need parsing get it here: a
+            # concurrency axis may use the documented "3n" form
+            if isinstance(o.get("concurrency"), str):
+                o["concurrency"] = parse_concurrency(
+                    o["concurrency"], o.get("nodes") or [])
+            with build_lock:
+                if "seed" in params:
+                    random.seed(params["seed"])
+                return test_fn(o)
+
+        cells = [{"id": c["id"], "group": c["group"],
+                  "params": c["params"], "build": build}
+                 for c in cells_plan]
+        try:
+            report = campaign.run_cells(
+                cells, parallel=options.get("parallel", 1),
+                device_slots=options.get("device-slots", 1),
+                campaign_id=options.get("campaign-id"),
+                resume=bool(options.get("resume")))
+        except campaign.CampaignError as e:
+            raise CliError(str(e)) from e
+        print(campaign.report.render_text(report))
+        sys.exit(campaign_exit_code(report))
+
+    return {"campaign": {"opt-spec": add_opts,
+                         "opt-fn": opts.get("opt-fn"),
+                         "run": run_campaign,
+                         "help": "Run a sweep matrix as a parallel, "
+                                 "resumable campaign."}}
 
 
 def serve_cmd():

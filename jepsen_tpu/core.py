@@ -359,9 +359,10 @@ def with_logging(test):
     """Per-test log file around the body; logs crashes so they land in the
     test's own log (core.clj:296-307, store.clj:431-460)."""
     named = bool(test.get("name"))
+    handler = None
     try:
         if named:
-            store.start_logging(test)
+            handler = store.start_logging(test)
             test["store_dir"] = store.path(test)
         logger.info("Running test: %s", test.get("name"))
         yield
@@ -369,8 +370,11 @@ def with_logging(test):
         logger.warning("Test crashed!\n%s", traceback.format_exc())
         raise
     finally:
-        if named:
-            store.stop_logging()
+        # handler is None when start_logging itself raised; the
+        # no-arg pop-latest fallback would detach a concurrent
+        # sibling cell's live handler instead
+        if named and handler is not None:
+            store.stop_logging(handler)
 
 
 @contextlib.contextmanager

@@ -44,6 +44,11 @@ __all__ = [
 _lock = threading.Lock()
 _tracer = None
 _registry = None
+#: active bind() scopes in bind order; the newest is the live pair.
+#: Exit removes a scope's OWN entry wherever it sits, so overlapping
+#: scopes (campaign cells overlap core.runs) unwind in any order
+#: without severing a live sibling or leaking a dead pair.
+_bind_stack = []
 
 
 def tracer():
@@ -64,19 +69,31 @@ def enabled():
 def bind(tr=None, reg=None):
     """Install (tracer, registry) as the process-wide sinks for the
     duration. Re-entrant for same-thread nesting: the previous pair is
-    restored on exit. Like store's per-test log handler, the binding
-    assumes one test run at a time per process — two OVERLAPPING
-    core.runs on different threads would restore out of order and
-    cross-attribute telemetry (harmless to the runs themselves)."""
+    restored on exit.
+
+    OVERLAPPING binds (campaign cells run core.run concurrently) get
+    last-binder-wins semantics: the live pair is the newest still-open
+    scope's, and a scope's exit removes its OWN stack entry wherever
+    it sits — so the first cell to FINISH can no longer null out a
+    still-running sibling's binding mid-run (telemetry then
+    cross-attributes to the newest binder, documented best-effort,
+    instead of silently vanishing), and the last scope out always
+    unbinds cleanly."""
     global _tracer, _registry
+    entry = (tr, reg)
     with _lock:
-        prev = (_tracer, _registry)
+        _bind_stack.append(entry)
         _tracer, _registry = tr, reg
     try:
         yield (tr, reg)
     finally:
         with _lock:
-            _tracer, _registry = prev
+            for i in range(len(_bind_stack) - 1, -1, -1):
+                if _bind_stack[i] is entry:
+                    del _bind_stack[i]
+                    break
+            _tracer, _registry = _bind_stack[-1] if _bind_stack \
+                else (None, None)
 
 
 def run_scope(test):

@@ -155,8 +155,10 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     if not live:
         return results
 
-    # common bucket sizes across live keys
-    n_pad = _bucket(max(len(pairs[k][0]) for k in live), 64)
+    # common bucket sizes across live keys (the op-count floor is the
+    # campaign-tunable shared bucket, jax_wgl._n_floor)
+    n_pad = _bucket(max(len(pairs[k][0]) for k in live),
+                    jax_wgl._n_floor())
     A = max(int(pairs[k][0].args.reshape(len(pairs[k][0]), -1).shape[1])
             for k in live)
     S_pad = max(len(pairs[k][1]) for k in live)
@@ -215,6 +217,13 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     while len(cols) < K:
         cols.append(_dummy_key(n_pad, S_pad, A))
         salts.append(np.uint32(0))
+    # cross-run compile-reuse ledger (campaign.compile_cache): the key
+    # mirrors the initial _build_search lru/jit key; compaction
+    # rebuilds mid-search are not separately accounted
+    jax_wgl._note_compile(
+        "jax-wgl-batch",
+        (spec.name, K, W, n_pad, B, S_pad, C, A, O, T, G, R_batch,
+         rollout_seeds, mesh is not None))
     perms = [c[7] for c in cols]          # host-only: witness decoding
     consts = tuple(jnp.asarray(np.stack([c[i] for c in cols]))
                    for i in range(7)) + (jnp.asarray(np.asarray(salts)),)

@@ -45,6 +45,12 @@ DEFAULT_NONSERIALIZABLE_KEYS = {
 #: line, appended as the run progresses; finalized into history.jsonl)
 JOURNAL_FILE = "history.jsonl.journal"
 
+#: directory under base_dir holding campaign state
+#: (``store/campaigns/<campaign-id>/campaign.json`` + ``cells.jsonl``
+#: + ``report.json``, written by jepsen_tpu.campaign.journal); the
+#: name is reserved -- test_names() skips it
+CAMPAIGNS_DIR = "campaigns"
+
 TIME_FORMAT = "%Y%m%dT%H%M%S.%f%z"
 
 
@@ -373,6 +379,97 @@ def memoized_load_results(test_name, test_time):
 
 
 # ---------------------------------------------------------------------------
+# campaigns (jepsen_tpu.campaign)
+
+def campaign_path(campaign_id, *args):
+    """A campaign's directory (or a file inside it):
+    ``base_dir/campaigns/<id>/...``."""
+    assert campaign_id, "campaign needs an id"
+    return os.path.join(base_dir, CAMPAIGNS_DIR, str(campaign_id),
+                        *map(str, args))
+
+
+def campaigns():
+    """All campaign ids in the store (those with a campaign.json)."""
+    root = os.path.join(base_dir, CAMPAIGNS_DIR)
+    try:
+        return sorted(
+            d for d in os.listdir(root)
+            if os.path.isfile(os.path.join(root, d, "campaign.json")))
+    except FileNotFoundError:
+        return []
+
+
+def latest_campaign():
+    """The most recently updated campaign id, or None. "Updated" is
+    campaign.json's mtime: write_meta rewrites it at start, resume,
+    and finalize."""
+    best, best_t = None, None
+    for cid in campaigns():
+        try:
+            t = os.path.getmtime(campaign_path(cid, "campaign.json"))
+        except OSError:  # pragma: no cover - raced deletion
+            continue
+        if best_t is None or t > best_t:
+            best, best_t = cid, t
+    return best
+
+
+def load_campaign(campaign_id):
+    """A campaign's state: campaign.json plus the cell records
+    (cells.jsonl, torn last line dropped) and report.json when
+    present. Returns None for an unknown campaign."""
+    try:
+        with open(campaign_path(campaign_id, "campaign.json")) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        return None
+    out = {"meta": meta, "records": load_campaign_records(campaign_id)}
+    try:
+        with open(campaign_path(campaign_id, "report.json")) as f:
+            out["report"] = json.load(f)
+    except FileNotFoundError:
+        pass
+    return out
+
+
+def latest_campaign_records(campaign_id):
+    """One record per cell, latest wins -- THE fold every consumer of
+    the journal must agree on (resume skipping, the final report, the
+    web view): a resumed campaign's journal keeps superseded records
+    (e.g. an "aborted" row under the re-run's terminal row)."""
+    latest = {}
+    for rec in load_campaign_records(campaign_id):
+        latest[rec.get("cell")] = rec
+    return list(latest.values())
+
+
+def load_campaign_records(campaign_id):
+    """The per-cell outcome records of a campaign, append order.
+    Unparseable lines are skipped with a warning, wherever they sit: a
+    process killed mid-append leaves a torn FINAL line, and a later
+    resume terminates that fragment in place (journal.append_cell), so
+    after a crash+resume the fragment is an interior line -- the
+    journal is crash-only and every surviving record still counts."""
+    out = []
+    try:
+        with open(campaign_path(campaign_id, "cells.jsonl")) as f:
+            lines = f.readlines()
+    except FileNotFoundError:
+        return out
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            logger.warning("skipping torn campaign journal line "
+                           "for %s", campaign_id)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # browsing
 
 def test_names():
@@ -382,7 +479,7 @@ def test_names():
             d for d in os.listdir(base_dir)
             if os.path.isdir(os.path.join(base_dir, d))
             and not os.path.islink(os.path.join(base_dir, d))
-            and d not in ("latest", "current"))
+            and d not in ("latest", "current", CAMPAIGNS_DIR))
     except FileNotFoundError:
         return []
 
@@ -432,8 +529,13 @@ def delete(test_name=None, test_time=None):
 # ---------------------------------------------------------------------------
 # per-test logging (store.clj:415-460)
 
-_log_handler = None
-# RLock: start_logging calls stop_logging under the same lock
+#: active per-test log handlers, in start order. A STACK, not a single
+#: slot: campaign cells overlap core.runs, and the old
+#: stop-previous-on-start behavior severed a still-running sibling's
+#: jepsen.log. All attached handlers receive all records (process-wide
+#: root logger), so parallel cells interleave lines but every cell's
+#: file is complete.
+_log_handlers = []
 _log_lock = threading.RLock()
 
 LOG_PATTERN = "%(asctime)s\t%(levelname)s\t[%(threadName)s] %(name)s: " \
@@ -454,10 +556,10 @@ class _JsonFormatter(logging.Formatter):
 def start_logging(test):
     """Starts logging to jepsen.log in the test's directory; updates the
     current symlink (store.clj:431-452). :logging-json? selects JSON
-    structured logs."""
-    global _log_handler
+    structured logs. Returns the handler: overlapping runs (campaign
+    cells) pass it back to ``stop_logging`` so each run detaches its
+    OWN file, in any completion order."""
     with _log_lock:
-        stop_logging()
         handler = logging.FileHandler(make_path(test, "jepsen.log"))
         if test.get("logging-json?"):
             handler.setFormatter(_JsonFormatter())
@@ -471,15 +573,22 @@ def start_logging(test):
         if root.level > logging.INFO or root.level == logging.NOTSET:
             root.setLevel(logging.INFO)
         root.addHandler(handler)
-        _log_handler = handler
+        _log_handlers.append(handler)
     update_current_symlink(test)
+    return handler
 
 
-def stop_logging():
-    """Removes the per-test log file handler (store.clj:453-460)."""
-    global _log_handler
+def stop_logging(handler=None):
+    """Removes a per-test log file handler (store.clj:453-460): the
+    given one, or the most recently started (the single-run case)."""
     with _log_lock:
-        if _log_handler is not None:
-            logging.getLogger().removeHandler(_log_handler)
-            _log_handler.close()
-            _log_handler = None
+        if handler is None:
+            handler = _log_handlers[-1] if _log_handlers else None
+        if handler is None:
+            return
+        try:
+            _log_handlers.remove(handler)
+        except ValueError:      # already stopped: idempotent
+            return
+        logging.getLogger().removeHandler(handler)
+        handler.close()

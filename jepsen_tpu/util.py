@@ -18,28 +18,29 @@ MICRO = 1_000
 MILLI = 1_000_000
 SECOND = 1_000_000_000
 
-_relative_origin = threading.local()
-_global_origin = None
+#: t=0 for relative_time_nanos. A CONTEXTVAR, not a process global: the
+#: campaign scheduler overlaps core.runs on sibling threads, and with a
+#: shared global the first run's exit wiped the origin out from under
+#: every still-running sibling. Each run's origin flows to its
+#: interpreter event loop (same thread) and to spawned workers through
+#: the contextvars.copy_context() snapshots the fan-outs already take.
+_origin_var = contextvars.ContextVar("jepsen_relative_origin",
+                                     default=None)
 
 
 @contextlib.contextmanager
 def with_relative_time():
-    """Establish t=0 for relative_time_nanos (util.clj:328-347). The origin is
-    global (all worker threads share it), mirroring the reference's var."""
-    global _global_origin
-    # codelint: ok -- save/restore of one atomic reference, bound once
-    # per run by the single-threaded lifecycle before workers spawn
-    prev = _global_origin
-    # codelint: ok -- see above
-    _global_origin = _time.monotonic_ns()
+    """Establish t=0 for relative_time_nanos (util.clj:328-347) in the
+    current context (and, via context snapshots, its child threads)."""
+    token = _origin_var.set(_time.monotonic_ns())
     try:
         yield
     finally:
-        _global_origin = prev  # codelint: ok -- see above
+        _origin_var.reset(token)
 
 
 def relative_time_nanos() -> int:
-    origin = _global_origin
+    origin = _origin_var.get()
     if origin is None:
         raise RuntimeError("No relative time origin: use with_relative_time()")
     return _time.monotonic_ns() - origin
@@ -49,8 +50,7 @@ def relative_time_nanos() -> int:
 def ensure_relative_time():
     """Establish a relative-time origin unless one is already active (the
     interpreter may run standalone or under core.run's origin)."""
-    global _global_origin
-    if _global_origin is not None:
+    if _origin_var.get() is not None:
         yield
         return
     with with_relative_time():

@@ -82,9 +82,76 @@ def _home_page():
     return f"""<html><head><style>{STYLE}</style>
 <title>Jepsen</title></head><body>
 <h1>Jepsen</h1>
+<p><a href="/campaigns">Campaigns</a></p>
 <table><thead><tr><th>Test</th><th>Time</th><th>Valid?</th>
 <th>Observability</th><th></th>
 </tr></thead><tbody>{''.join(rows)}</tbody></table></body></html>"""
+
+
+def _run_link(path):
+    """A /files link for a recorded store path (campaign records store
+    paths relative to the working directory, base_dir-prefixed)."""
+    if not path:
+        return ""
+    rel = os.path.relpath(str(path), store.base_dir)
+    if rel.startswith(".."):
+        return ""
+    return f"/files/{urllib.parse.quote(rel)}/"
+
+
+def _campaign_cell_class(outcome):
+    if outcome is True:
+        return "valid-true"
+    if outcome is False or outcome == "crashed":
+        return "valid-false"
+    return "valid-unknown"
+
+
+def _campaigns_page():
+    """Campaign index: one section per campaign, its runs grouped by
+    cell (web's view of store/campaigns/<id>/)."""
+    sections = []
+    for cid in sorted(store.campaigns(), reverse=True):
+        data = store.load_campaign(cid)
+        if data is None:
+            continue
+        meta = data["meta"]
+        # latest record per cell (a resumed campaign's journal keeps
+        # superseded "aborted" rows): store's shared fold
+        records = store.latest_campaign_records(cid)
+        counts = {}
+        for r in records:
+            k = str(r.get("outcome"))
+            counts[k] = counts.get(k, 0) + 1
+        badge = ", ".join(f"{k}: {v}" for k, v in sorted(counts.items()))
+        rows = []
+        for r in records:
+            link = _run_link(r.get("path"))
+            path_cell = (f'<a href="{link}">'
+                         f'{html.escape(str(r.get("path")))}</a>'
+                         if link else html.escape(str(r.get("path"))))
+            rows.append(
+                f'<tr class="{_campaign_cell_class(r.get("outcome"))}">'
+                f'<td>{html.escape(str(r.get("cell")))}</td>'
+                f'<td>{html.escape(str(r.get("outcome")))}</td>'
+                f'<td>{html.escape(str(r.get("valid")))}</td>'
+                f'<td>{path_cell}</td>'
+                f'<td>{html.escape(str(r.get("wall_s", "")))}</td>'
+                f"</tr>")
+        planned = len(meta.get("cells") or [])
+        files = f"/files/{store.CAMPAIGNS_DIR}/{urllib.parse.quote(cid)}/"
+        sections.append(
+            f'<h2><a href="{files}">{html.escape(cid)}</a></h2>'
+            f"<p>status: {html.escape(str(meta.get('status')))} &mdash; "
+            f"{len(records)}/{planned} cells ({html.escape(badge)})</p>"
+            f"<table><thead><tr><th>Cell</th><th>Outcome</th>"
+            f"<th>Valid?</th><th>Run</th><th>Wall (s)</th></tr></thead>"
+            f"<tbody>{''.join(rows)}</tbody></table>")
+    body = "".join(sections) or "<p>No campaigns yet.</p>"
+    return f"""<html><head><style>{STYLE}</style>
+<title>Jepsen campaigns</title></head><body>
+<h1>Campaigns</h1><p><a href="/">&larr; tests</a></p>
+{body}</body></html>"""
 
 
 def _dir_page(rel, full):
@@ -128,6 +195,8 @@ class Handler(BaseHTTPRequestHandler):
                 urllib.parse.urlparse(self.path).path)
             if path in ("", "/"):
                 return self._send(200, _home_page())
+            if path.rstrip("/") == "/campaigns":
+                return self._send(200, _campaigns_page())
             if path.startswith("/files/"):
                 return self._files(path[len("/files/"):])
             return self._send(404, "<h1>404</h1>")

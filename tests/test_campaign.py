@@ -1,0 +1,708 @@
+"""Campaign subsystem tests: matrix expansion + PL012, the parallel
+scheduler (ordering independence, device slots), abort -> resume,
+cross-run compile reuse counters, flake detection, and the cli
+test-all fixes that ride along."""
+
+import json
+import os
+
+import pytest
+
+from jepsen_tpu import checker as cc
+from jepsen_tpu import cli
+from jepsen_tpu import client as jc
+from jepsen_tpu import generator as gen
+from jepsen_tpu import store
+from jepsen_tpu import tests as tst
+from jepsen_tpu.campaign import compile_cache, journal, plan, report
+from jepsen_tpu.campaign import scheduler
+from jepsen_tpu.checker import checkers as cks
+from jepsen_tpu.checker.core import FnChecker
+from jepsen_tpu.robust import AbortLatch
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+
+
+def dummy_test(**kw):
+    t = tst.noop_test()
+    t["ssh"] = {"dummy?": True}
+    t["obs?"] = False
+    t.update(kw)
+    return t
+
+
+class OkClient(jc.Client):
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        out = dict(op)
+        out["type"] = "ok"
+        return out
+
+
+def quick_cell(name, valid=True, ops=3):
+    checker = cc.noop() if valid else FnChecker(
+        lambda t, h, o: {"valid": False}, "nope")
+    return dummy_test(
+        name=name, nodes=["n1"], concurrency=1, client=OkClient(),
+        checker=checker,
+        generator=gen.clients(gen.limit(ops, gen.repeat({"f": "read"}))))
+
+
+# ---------------------------------------------------------------------------
+# plan: matrix expansion + PL012
+
+
+def test_matrix_expansion_deterministic():
+    cells = plan.expand({"base": {"time-limit": 5},
+                         "axes": {"workload": ["a", "b"],
+                                  "concurrency": [2, 4]}})
+    assert len(cells) == 4
+    assert cells[0]["id"] == "concurrency=2,workload=a"
+    assert cells[0]["params"] == {"time-limit": 5, "concurrency": 2,
+                                  "workload": "a"}
+    # deterministic order: sorted axis names, values in given order
+    assert [c["id"] for c in cells] == [
+        "concurrency=2,workload=a", "concurrency=2,workload=b",
+        "concurrency=4,workload=a", "concurrency=4,workload=b"]
+    # groups strip the seed axis only
+    cells = plan.expand({"axes": {"workload": ["a"], "seed": [0, 1]}})
+    assert {c["group"] for c in cells} == {"workload=a"}
+    assert {c["id"] for c in cells} == {"seed=0,workload=a",
+                                        "seed=1,workload=a"}
+
+
+def test_matrix_seeds_shorthand_and_plain_form():
+    cells = plan.expand({"workload": ["a"], "seeds": 3,
+                         "time-limit": 9})
+    assert len(cells) == 3
+    assert all(c["params"]["time-limit"] == 9 for c in cells)
+    assert sorted(c["params"]["seed"] for c in cells) == [0, 1, 2]
+
+
+def test_pl012_empty_matrix_is_error():
+    diags = plan.lint({})
+    assert any(d.code == "PL012" and d.severity == "error"
+               for d in diags)
+    with pytest.raises(plan.CampaignPlanError):
+        plan.validate({"axes": {}})
+    with pytest.raises(plan.CampaignPlanError):
+        plan.validate({"axes": {"workload": []}})
+
+
+def test_pl012_duplicate_cell_ids_and_seed_collisions():
+    # "a b" and "a_b" sanitize to the same id fragment -> duplicate ids
+    diags = plan.lint({"axes": {"workload": ["a b", "a_b"]}})
+    assert any(d.code == "PL012" and d.severity == "error"
+               and "duplicate" in d.message for d in diags)
+    diags = plan.lint({"axes": {"seed": [1, 1]}})
+    assert any(d.code == "PL012" and "seed" in d.message.lower()
+               for d in diags)
+
+
+def test_pl012_per_cell_knobs_via_pl011_rules():
+    diags = plan.lint({"base": {"op-timeout-ms": 99000},
+                       "axes": {"time-limit-s": [60, 120]}})
+    warn = [d for d in diags if d.code == "PL012"]
+    # 99000 ms >= 60 s deadline trips in exactly the time-limit-s=60
+    # cell
+    assert any("op-timeout-ms" in d.message for d in warn)
+    assert any("time-limit-s=60" in d.location for d in warn)
+    assert not any("time-limit-s=120" in d.location for d in warn)
+
+
+# ---------------------------------------------------------------------------
+# scheduler: parallel execution, ordering independence, device slots
+
+
+def outcome_map(rep):
+    return {r["cell"]: r["outcome"] for r in rep["cells"]}
+
+
+def test_campaign_outcomes_independent_of_parallelism():
+    def cells():
+        return [
+            {"id": "ok-1", "test": quick_cell("ok-1")},
+            {"id": "ok-2", "test": quick_cell("ok-2")},
+            {"id": "bad-1", "test": quick_cell("bad-1", valid=False)},
+            {"id": "bad-2", "test": quick_cell("bad-2", valid=False)},
+        ]
+
+    seq = scheduler.run_cells(cells(), campaign_id="seq", parallel=1)
+    par = scheduler.run_cells(cells(), campaign_id="par", parallel=3)
+    want = {"ok-1": True, "ok-2": True, "bad-1": False, "bad-2": False}
+    assert outcome_map(seq) == want
+    assert outcome_map(par) == want
+    assert seq["status"] == par["status"] == "complete"
+    # journal + report landed on disk, campaign dir excluded from tests
+    meta = json.load(open(store.campaign_path("par", "campaign.json")))
+    assert meta["status"] == "complete"
+    assert sorted(meta["cells"]) == sorted(want)
+    assert "campaigns" not in store.test_names()
+    assert set(store.campaigns()) == {"seq", "par"}
+    # exit-code plumbing: failures beat successes
+    assert cli.test_all_exit_code(par["results"]) == 1
+
+
+def test_device_slot_serializes_checks():
+    import threading
+    active = []
+    peak = []
+    lock = threading.Lock()
+
+    def slow_check(t, h, o):
+        with lock:
+            active.append(1)
+            peak.append(len(active))
+        import time
+        time.sleep(0.05)
+        with lock:
+            active.pop()
+        return {"valid": True}
+
+    cells = [{"id": f"c{i}",
+              "test": quick_cell(f"c{i}")} for i in range(4)]
+    for c in cells:
+        c["test"]["checker"] = FnChecker(slow_check, "slow")
+    rep = scheduler.run_cells(cells, campaign_id="slots", parallel=4,
+                              device_slots=1)
+    assert all(o is True for o in outcome_map(rep).values())
+    assert max(peak) == 1, "device-slot semaphore must serialize checks"
+
+
+# ---------------------------------------------------------------------------
+# abort -> journal -> resume
+
+
+def test_abort_mid_campaign_then_resume_skips_completed():
+    latch = AbortLatch()
+    ran = []
+
+    class AbortingClient(OkClient):
+        def __init__(self, after):
+            self.after = after
+            self.n = 0
+
+        def invoke(self, test, op):
+            self.n += 1
+            if self.n == self.after:
+                latch.set("SIGINT")
+            return super().invoke(test, op)
+
+    def build_cells(counter):
+        cells = []
+        for i in range(4):
+            name = f"cell-{i}"
+            client = AbortingClient(3) if i == 1 else OkClient()
+
+            def mk(params, name=name, client=client):
+                counter.append(name)
+                return dummy_test(
+                    name=name, nodes=["n1"], concurrency=1,
+                    client=client, checker=cc.noop(),
+                    generator=gen.clients(gen.limit(
+                        6, gen.repeat({"f": "read"}))))
+
+            cells.append({"id": name, "build": mk, "params": {}})
+        return cells
+
+    rep = scheduler.run_cells(build_cells(ran), campaign_id="abrt",
+                              parallel=1, latch=latch)
+    assert rep["status"] == "aborted"
+    assert rep["abort-reason"] == "SIGINT"
+    # cell-0 finished, cell-1 aborted mid-run, cells 2/3 never started
+    assert ran == ["cell-0", "cell-1"]
+    om = outcome_map(rep)
+    assert om["cell-0"] is True
+    assert om["cell-1"] == "aborted"
+    assert "cell-2" not in om and "cell-3" not in om
+    # the journal survived with exactly those records
+    jr = journal.CampaignJournal("abrt")
+    assert set(jr.completed()) == {"cell-0"}
+    assert (json.load(open(jr.meta_path))["status"]) == "aborted"
+    # resume: only unfinished cells execute
+    ran2 = []
+    rep2 = scheduler.run_cells(build_cells(ran2), campaign_id="abrt",
+                               parallel=1, resume=True,
+                               latch=AbortLatch())
+    assert sorted(ran2) == ["cell-1", "cell-2", "cell-3"]
+    om2 = outcome_map(rep2)
+    assert om2 == {f"cell-{i}": True for i in range(4)}
+    assert rep2["status"] == "complete"
+    assert rep2["summary"]["skipped-resumed"] == 1
+    assert cli.test_all_exit_code(rep2["results"]) == 0
+
+
+def test_own_deadline_abort_is_terminal_not_resumed():
+    """A cell that aborts on its OWN time-limit-s deadline (no campaign
+    latch) ran as planned: it must journal a terminal outcome, or
+    --resume would re-run it to the same deadline forever."""
+    class SlowClient(OkClient):
+        def invoke(self, test, op):
+            import time
+            time.sleep(0.05)
+            return super().invoke(test, op)
+
+    t = dummy_test(
+        name="deadline", nodes=["n1"], concurrency=1,
+        client=SlowClient(), checker=cc.noop(),
+        **{"time-limit-s": 0.3, "abort-grace-s": 0.5},
+        generator=gen.clients(gen.repeat({"f": "read"})))
+    rep = scheduler.run_cells([{"id": "d", "test": t}],
+                              campaign_id="dl", parallel=1)
+    assert rep["status"] == "complete"
+    rec = rep["cells"][0]
+    assert rec["outcome"] is True          # salvaged + checked verdict
+    assert rec["abort-reason"] == "time-limit"
+    assert set(journal.CampaignJournal("dl").completed()) == {"d"}
+
+
+def test_resume_guards():
+    with pytest.raises(scheduler.CampaignError):
+        scheduler.run_cells([], campaign_id="nope", resume=True)
+    with pytest.raises(scheduler.CampaignError):
+        scheduler.run_cells([], resume=True)  # empty store, no latest
+    scheduler.run_cells([{"id": "a", "test": quick_cell("a")}],
+                        campaign_id="g1")
+    # resuming with a mismatched matrix is refused
+    with pytest.raises(scheduler.CampaignError):
+        scheduler.run_cells([{"id": "b", "test": quick_cell("b")}],
+                            campaign_id="g1", resume=True)
+    # ... and so is starting FRESH over an existing campaign id (the
+    # journal would mix two runs' records)
+    with pytest.raises(scheduler.CampaignError):
+        scheduler.run_cells([{"id": "a", "test": quick_cell("a")}],
+                            campaign_id="g1")
+    # without an id, resume picks the latest campaign
+    rep = scheduler.run_cells([{"id": "a", "test": quick_cell("a")}],
+                              resume=True)
+    assert rep["campaign"] == "g1"
+    assert rep["summary"]["skipped-resumed"] == 1
+
+
+def test_resume_refuses_stale_aborted_cells_not_in_plan():
+    """A non-terminal ('aborted') record for a cell the new plan no
+    longer contains must refuse the resume -- it would otherwise haunt
+    every later report and exit code."""
+    jr = journal.CampaignJournal("stale")
+    jr.write_meta({"status": "aborted", "cells": ["old", "keep"]})
+    jr.append_cell({"cell": "old", "outcome": "aborted"})
+    with pytest.raises(scheduler.CampaignError):
+        scheduler.run_cells([{"id": "keep", "test": quick_cell("keep")}],
+                            campaign_id="stale", resume=True)
+
+
+def test_journal_drops_torn_final_line():
+    jr = journal.CampaignJournal("torn")
+    jr.append_cell({"cell": "a", "outcome": True})
+    with open(jr.cells_path, "a") as f:
+        f.write('{"cell": "b", "outc')   # killed mid-append
+    assert [r["cell"] for r in jr.records()] == ["a"]
+    assert set(jr.completed()) == {"a"}
+    # a resume appends ONTO the torn tail: the fragment must be
+    # terminated, not merged into the new record (which would corrupt
+    # both and crash every later read)
+    jr.append_cell({"cell": "b", "outcome": True})
+    jr.append_cell({"cell": "c", "outcome": True})
+    assert [r["cell"] for r in jr.records()] == ["a", "b", "c"]
+    assert set(jr.completed()) == {"a", "b", "c"}
+
+
+def test_hard_abort_still_finalizes_journal_and_report():
+    """A KeyboardInterrupt escaping a cell (second SIGINT = hard
+    abort) must not skip finalize: campaign.json flips to "aborted"
+    and report.json lands before the exception propagates."""
+    def ki_run(test):
+        if test["campaign"]["cell"] == "k-1":
+            raise KeyboardInterrupt("hard abort")
+        return {**test, "results": {"valid": True}}
+
+    cells = [{"id": f"k-{i}", "test": quick_cell(f"k-{i}")}
+             for i in range(3)]
+    with pytest.raises(KeyboardInterrupt):
+        scheduler.run_cells(cells, campaign_id="hard", parallel=1,
+                            run_fn=ki_run)
+    jr = journal.CampaignJournal("hard")
+    assert jr.load_meta()["status"] == "aborted"
+    rep = jr.load_report()
+    assert rep["status"] == "aborted"
+    assert [r["cell"] for r in jr.records()] == ["k-0"]
+    assert cli.campaign_exit_code(rep) == 2
+
+
+def test_obs_bind_overlap_keeps_live_binding():
+    """The first of two overlapping per-run bindings to exit must not
+    null out its still-running sibling's sinks (campaign cells overlap
+    core.runs; identity-guarded restore in obs.bind)."""
+    from jepsen_tpu import obs
+    t1, r1 = obs.Tracer(), obs.Registry()
+    t2, r2 = obs.Tracer(), obs.Registry()
+    cm1 = obs.bind(t1, r1)
+    cm1.__enter__()
+    cm2 = obs.bind(t2, r2)
+    cm2.__enter__()
+    cm1.__exit__(None, None, None)       # first cell finishes first
+    try:
+        assert obs.registry() is r2      # sibling's binding survives
+        obs.inc("x")
+        assert r2.counter_value("x") == 1
+    finally:
+        cm2.__exit__(None, None, None)
+    # last scope out unbinds cleanly: no stale pair leaks
+    assert obs.registry() is None and obs.tracer() is None
+
+
+# ---------------------------------------------------------------------------
+# cross-run compile reuse
+
+
+def register_history_client():
+    class RegClient(jc.Client):
+        def __init__(self):
+            self.value = None
+
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            out = dict(op)
+            if op["f"] == "write":
+                self.value = op["value"]
+            else:
+                out["value"] = self.value
+            out["type"] = "ok"
+            return out
+
+    return RegClient()
+
+
+def lin_cell(name):
+    ops = []
+    for i in range(4):
+        ops.append({"type": "invoke", "f": "write", "value": i})
+        ops.append({"type": "invoke", "f": "read", "value": None})
+    it = iter(ops)
+
+    def next_op(test, ctx):
+        return next(it, None)
+
+    t = dummy_test(
+        name=name, nodes=["n1"], concurrency=1,
+        client=register_history_client(),
+        checker=cks.linearizable({"model": "register",
+                                  "algorithm": "jax-wgl"}),
+        generator=gen.clients(next_op))
+    t["obs?"] = True     # the per-cell metrics.json is the assertion
+    return t
+
+
+def test_compile_cache_hits_across_shape_identical_cells():
+    compile_cache.reset()
+    cells = [{"id": "lin-1", "test": lin_cell("lin-1")},
+             {"id": "lin-2", "test": lin_cell("lin-2")}]
+    rep = scheduler.run_cells(cells, campaign_id="cc", parallel=1)
+    assert outcome_map(rep) == {"lin-1": True, "lin-2": True}
+    # identical deterministic histories -> identical plan shapes -> the
+    # second cell's search is a ledger hit (jit cache reuse)
+    assert rep["compile_cache"]["hits"] >= 1
+    assert rep["compile_cache"]["misses"] >= 1
+    # campaign-level metrics carry the same numbers
+    metrics = json.load(open(store.campaign_path("cc", "metrics.json")))
+    assert metrics["gauges"]["campaign.compile_cache.hits"] >= 1
+    # and the obs mirror put per-cell counters in the second cell's own
+    # run metrics
+    run_metrics = json.load(open(os.path.join(
+        store.base_dir, "lin-2", "latest", "metrics.json")))
+    hits = [v for k, v in run_metrics["counters"].items()
+            if k.startswith("campaign.compile_cache.hits")]
+    assert sum(hits) >= 1
+
+
+def test_compile_cache_ledger_and_floor():
+    compile_cache.reset()
+    key = ("spec", 64, 2, 4)
+    assert compile_cache.note("e", key) is False
+    assert compile_cache.note("e", key) is True
+    assert compile_cache.note("e", ("spec", 128, 2, 4)) is False
+    s = compile_cache.stats()
+    assert s["hits"] == 1 and s["misses"] == 2 and s["shapes"] == 2
+    assert compile_cache.delta({"hits": 1, "misses": 0}) == \
+        {"hits": 0, "misses": 2}
+    assert compile_cache.bucket(900, 64) == 1024
+    with compile_cache.bucket_floor(2048):
+        assert compile_cache.n_floor() == 2048
+        from jepsen_tpu.checker import jax_wgl
+        assert jax_wgl._n_floor() == 2048
+        assert jax_wgl._bucket(900, jax_wgl._n_floor()) == 2048
+    assert compile_cache.n_floor() == compile_cache.DEFAULT_N_FLOOR
+    compile_cache.reset()
+
+
+# ---------------------------------------------------------------------------
+# report: flakes + triage
+
+
+def test_flake_detection_on_divergent_seeded_validity():
+    records = [
+        {"cell": "seed=0,w=a", "group": "w=a", "outcome": True,
+         "valid": True},
+        {"cell": "seed=1,w=a", "group": "w=a", "outcome": False,
+         "valid": False},
+        {"cell": "seed=0,w=b", "group": "w=b", "outcome": True,
+         "valid": True},
+        {"cell": "seed=1,w=b", "group": "w=b", "outcome": True,
+         "valid": True},
+        # aborted cells carry no verdict: never flake evidence
+        {"cell": "seed=2,w=b", "group": "w=b", "outcome": "aborted",
+         "valid": "unknown"},
+    ]
+    rep = report.summarize(records)
+    assert [f["group"] for f in rep["flakes"]] == ["w=a"]
+    assert rep["flakes"][0]["validities"] == ["False", "True"]
+    text = report.render_text(rep)
+    assert "w=a" in text and "flaky" in text
+
+
+def test_triage_groups_by_failure_signature():
+    records = [
+        {"cell": "c1", "outcome": "crashed",
+         "error": "Traceback ...\nRuntimeError: boom"},
+        {"cell": "c2", "outcome": "crashed",
+         "error": "Traceback ...\nRuntimeError: boom"},
+        {"cell": "c3", "outcome": "aborted", "abort-reason": "SIGINT"},
+        {"cell": "c4", "outcome": True},
+    ]
+    tri = report.summarize(records)["triage"]
+    assert tri["crashed: RuntimeError: boom"] == ["c1", "c2"]
+    assert tri["aborted: SIGINT"] == ["c3"]
+    assert not any("c4" in v for v in tri.values())
+
+
+# ---------------------------------------------------------------------------
+# cli satellites: crash containment, cell ids, exit codes
+
+
+def test_test_all_records_prepare_crash_and_continues():
+    # a malformed plan (nodes not a list) crashes prepare_test; the
+    # suite must record it as crashed and still run the next test
+    bad = {"name": "bad", "nodes": 42}
+    good = quick_cell("good")
+    results = cli.test_all_run_tests([bad, good])
+    assert len(results["crashed"]) == 1
+    assert results[True] and "good" in str(results[True][0])
+    assert cli.test_all_exit_code(results) == 255
+
+
+def test_test_all_summary_includes_cell_ids(capsys):
+    t = quick_cell("celltest")
+    t["campaign"] = {"id": "x", "cell": "seed=1,workload=w"}
+    results = cli.test_all_run_tests([t])
+    entry = results[True][0]
+    assert entry["cell"] == "seed=1,workload=w"
+    cli.test_all_print_summary(results)
+    out = capsys.readouterr().out
+    assert "[seed=1,workload=w]" in out
+    assert "celltest" in out
+
+
+def test_campaign_exit_code_covers_unrecorded_aborts():
+    # SIGINT between cells: every recorded cell passed, but the
+    # campaign is aborted with unrun cells -> must NOT exit 0
+    rep = {"status": "aborted", "results": {True: [{"cell": "a"}]}}
+    assert cli.campaign_exit_code(rep) == 2
+    rep = {"status": "aborted",
+           "results": {True: [{"cell": "a"}], False: [{"cell": "b"}]}}
+    assert cli.campaign_exit_code(rep) == 2
+    rep = {"status": "aborted", "results": {"crashed": [{"cell": "a"}]}}
+    assert cli.campaign_exit_code(rep) == 255
+    rep = {"status": "complete", "results": {True: [{"cell": "a"}]}}
+    assert cli.campaign_exit_code(rep) == 0
+
+
+def test_scheduler_contains_non_dict_build_crash():
+    cells = [{"id": "bogus", "build": lambda params: "not a test",
+              "params": {}},
+             {"id": "fine", "test": quick_cell("fine")}]
+    rep = scheduler.run_cells(cells, campaign_id="bog", parallel=1)
+    om = outcome_map(rep)
+    assert om["bogus"] == "crashed"
+    assert om["fine"] is True
+    assert rep["status"] == "complete"
+
+
+def test_exit_code_order_with_aborted():
+    # reference order 255 > 2 > 1 > 0; aborted ranks with unknown
+    assert cli.test_all_exit_code({"aborted": ["x"]}) == 2
+    assert cli.test_all_exit_code({"aborted": ["x"], False: ["y"]}) == 2
+    assert cli.test_all_exit_code({"crashed": ["x"],
+                                   "aborted": ["y"]}) == 255
+    assert cli.test_all_exit_code({True: ["x"]}) == 0
+
+
+def test_test_all_parallel_routes_through_campaign(capsys):
+    cmd = cli.test_all_cmd({
+        "tests-fn": lambda o: [quick_cell("ta-1"), quick_cell("ta-2")]})
+    with pytest.raises(SystemExit) as ei:
+        cmd["test-all"]["run"]({"parallel": 2, "device-slots": 1,
+                                "campaign-id": "ta", "resume": False})
+    assert ei.value.code == 0
+    recs = store.load_campaign_records("ta")
+    assert {r["cell"] for r in recs} == {"ta-1", "ta-2"}
+    out = capsys.readouterr().out
+    assert "[ta-1]" in out and "[ta-2]" in out
+    # and --resume alone reruns nothing
+    with pytest.raises(SystemExit) as ei:
+        cmd["test-all"]["run"]({"parallel": 1, "device-slots": 1,
+                                "campaign-id": None, "resume": True})
+    assert ei.value.code == 0
+    assert len(store.load_campaign_records("ta")) == 2
+    # --campaign-id ALONE routes through the scheduler too (it would
+    # otherwise be silently ignored and leave nothing to resume)
+    with pytest.raises(SystemExit) as ei:
+        cmd["test-all"]["run"]({"parallel": 1, "device-slots": 1,
+                                "campaign-id": "ta2", "resume": False})
+    assert ei.value.code == 0
+    assert len(store.load_campaign_records("ta2")) == 2
+
+
+def test_parse_axes():
+    axes = cli.parse_axes(["workload=a,b", "concurrency=2,4"], seeds=2)
+    assert axes == {"workload": ["a", "b"], "concurrency": [2, 4],
+                    "seed": [0, 1]}
+    with pytest.raises(cli.CliError):
+        cli.parse_axes(["oops"])
+
+
+# ---------------------------------------------------------------------------
+# web: campaign index
+
+
+def test_web_campaigns_page():
+    rep_cells = [{"id": "w-ok", "test": quick_cell("w-ok")},
+                 {"id": "w-bad", "test": quick_cell("w-bad",
+                                                    valid=False)}]
+    scheduler.run_cells(rep_cells, campaign_id="webc", parallel=1)
+    from jepsen_tpu import web
+    page = web._campaigns_page()
+    assert "webc" in page
+    assert "w-ok" in page and "w-bad" in page
+    assert "valid-false" in page
+    # cell rows link into the per-run store directories
+    assert "/files/w-ok/" in page
+    # resumed campaigns render latest-record-per-cell, not raw journal
+    jr = journal.CampaignJournal("webc")
+    jr.append_cell({"cell": "w-ok", "outcome": "aborted",
+                    "valid": "unknown", "path": None})
+    jr.append_cell({"cell": "w-ok", "outcome": True, "valid": True,
+                    "path": None})
+    page = web._campaigns_page()
+    assert page.count("<td>w-ok</td>") == 1
+    assert "2/2 cells" in page
+
+
+def test_cli_campaign_end_to_end():
+    from jepsen_tpu import __main__ as main_mod
+    # the acceptance-criteria shape: a 2x2 CPU campaign, --parallel 2
+    with pytest.raises(SystemExit) as ei:
+        main_mod.main(["campaign", "--no-ssh", "--time-limit", "1",
+                       "--axis", "workload=noop,bank", "--seeds", "2",
+                       "--parallel", "2", "--campaign-id", "smoke"])
+    assert ei.value.code == 0
+    meta = json.load(open(store.campaign_path("smoke",
+                                              "campaign.json")))
+    assert meta["id"] == "smoke"
+    assert meta["status"] == "complete"
+    assert len(meta["cells"]) == 4
+    recs = store.load_campaign_records("smoke")
+    assert len(recs) == 4
+    assert all(r["outcome"] is True for r in recs)
+    report_ = json.load(open(store.campaign_path("smoke",
+                                                 "report.json")))
+    assert report_["summary"]["outcomes"] == {"True": 4}
+    # rerunning with --resume is a no-op: everything already journaled
+    with pytest.raises(SystemExit) as ei:
+        main_mod.main(["campaign", "--no-ssh", "--time-limit", "1",
+                       "--axis", "workload=noop,bank", "--seeds", "2",
+                       "--campaign-id", "smoke", "--resume"])
+    assert ei.value.code == 0
+    assert len(store.load_campaign_records("smoke")) == 4
+
+
+def test_cli_campaign_lint_dry_run(capsys):
+    from jepsen_tpu import __main__ as main_mod
+    with pytest.raises(SystemExit) as ei:
+        main_mod.main(["campaign", "--no-ssh", "--seeds", "2",
+                       "--lint"])
+    assert ei.value.code == 0
+    out = capsys.readouterr().out
+    assert "seed=0" in out and "seed=1" in out
+    # an empty matrix is a PL012 error: lint exits 1, nothing runs
+    with pytest.raises(SystemExit) as ei:
+        main_mod.main(["campaign", "--no-ssh", "--lint"])
+    assert ei.value.code == 1
+    assert store.campaigns() == []
+
+
+def test_store_logging_stack_survives_overlap():
+    """Overlapping per-test log scopes (parallel cells): the first run
+    to finish detaches only its OWN jepsen.log handler; the sibling's
+    file keeps receiving records."""
+    import logging
+    ts = "20260803T000000.000000+0000"
+    ta = {"name": "log-a", "start-time": ts}
+    tb = {"name": "log-b", "start-time": ts}
+    ha = store.start_logging(ta)
+    hb = store.start_logging(tb)
+    log = logging.getLogger("campaign-log-test")
+    log.info("while-both")
+    store.stop_logging(ha)           # A finishes first
+    log.info("after-a-stopped")
+    store.stop_logging(hb)
+    store.stop_logging(hb)           # idempotent
+    with open(store.path(tb, "jepsen.log")) as f:
+        b_log = f.read()
+    assert "while-both" in b_log
+    assert "after-a-stopped" in b_log     # B was NOT severed
+    with open(store.path(ta, "jepsen.log")) as f:
+        a_log = f.read()
+    assert "after-a-stopped" not in a_log
+
+
+def test_axis_concurrency_suffix_syntax():
+    """A concurrency axis may use the documented '3n' form: the value
+    lands after test_opt_fn ran, so the build re-parses it."""
+    seen = []
+
+    def tf(o):
+        seen.append(o["concurrency"])
+        return quick_cell(f"c{o['concurrency']}")
+
+    cmd = cli.campaign_cmd({"test-fn": tf})
+    with pytest.raises(SystemExit) as ei:
+        cmd["campaign"]["run"]({"axis": ["concurrency=2n,3n"],
+                                "seeds": None, "parallel": 1,
+                                "device-slots": 1,
+                                "campaign-id": "cnx", "resume": False,
+                                "nodes": ["n1", "n2"]})
+    assert ei.value.code == 0
+    assert sorted(seen) == [4, 6]
+
+
+def test_unique_start_times_for_same_name_cells():
+    s1 = scheduler._unique_start_time("dup")
+    s2 = scheduler._unique_start_time("dup")
+    assert s1 != s2
+
+
+def test_core_run_marks_campaign_serializable():
+    t = quick_cell("serial")
+    rep = scheduler.run_cells([{"id": "c", "test": t}],
+                              campaign_id="ser", parallel=1)
+    path = rep["cells"][0]["path"]
+    saved = json.load(open(os.path.join(path, "test.json")))
+    assert saved["campaign"]["id"] == "ser"
+    assert saved["campaign"]["cell"] == "c"

@@ -108,6 +108,76 @@ class OracleRace:
         return out
 
 
+def _monitor_rung(n_ops=512, violate_at=256, chunk=64):
+    """Streaming-monitor detection metrics (jepsen_tpu.monitor): feed a
+    synthetic cas-register stream with a violation injected half way
+    (a read of a never-written value -- definitively invalid) through
+    a standalone Monitor on the device engine, and report
+
+      time_to_first_verdict_s  wall from monitor start to its first
+                               definite chunk verdict (compile + first
+                               search; the cold-start cost)
+      detection_latency_s      wall from the violating op landing to
+                               the violation being proven
+      abort_latency_s          wall from the violating op landing to
+                               the abort latch actually flipping
+
+    Self-contained and never fatal: a monitor regression must show up
+    as numbers (or an error field), not break the throughput bench."""
+    try:
+        from jepsen_tpu import monitor as jmon
+        from jepsen_tpu import robust
+        from jepsen_tpu.models import model_spec
+        spec = model_spec("cas-register")
+        latch = robust.ChainedLatch()
+        mon = jmon.Monitor(spec, latch, chunk=chunk,
+                           engine="jax-wgl").start()
+        t_violation = None
+        val = 0
+        for i in range(n_ops):
+            if i == violate_at:
+                ops = [{"type": "invoke", "process": 0, "f": "read",
+                        "value": None},
+                       {"type": "ok", "process": 0, "f": "read",
+                        "value": 10**6}]
+            elif i % 2 == 0:
+                val = i + 1
+                ops = [{"type": "invoke", "process": 0, "f": "write",
+                        "value": val},
+                       {"type": "ok", "process": 0, "f": "write",
+                        "value": val}]
+            else:
+                ops = [{"type": "invoke", "process": 0, "f": "read",
+                        "value": None},
+                       {"type": "ok", "process": 0, "f": "read",
+                        "value": val}]
+            for op in ops:
+                mon.offer(op)
+            if i == violate_at:
+                t_violation = time.monotonic()
+            if latch.is_set():
+                break
+        detected = latch.wait(120)
+        t_abort = time.monotonic()
+        mon.stop()
+        s = mon.summary()
+        return {
+            "detected": bool(detected),
+            "verdict": s.get("verdict"),
+            "chunk": chunk,
+            "ops_consumed": s.get("ops_consumed"),
+            "checks": s.get("checks"),
+            "time_to_first_verdict_s": s.get("time_to_first_verdict_s"),
+            "detection_latency_s": s.get("detection_latency_s"),
+            "abort_latency_s": (round(t_abort - t_violation, 4)
+                                if detected and t_violation is not None
+                                else None),
+            "detected_at_index": s.get("detected_at_index"),
+        }
+    except Exception as exc:  # noqa: BLE001 - numbers, not crashes
+        return {"error": repr(exc)}
+
+
 def _error_headline(msg):
     """The zero-value headline shape every bench failure path emits
     (one definition so error lines can't drift from success lines)."""
@@ -573,6 +643,12 @@ def _bench_body(_obs_reg):
                 "error": bad["error"]}}
         maxlen[row] = entry
     rungs["0-maxlen-60s"] = maxlen
+
+    # streaming-monitor rung: the BENCH trajectory's headline for the
+    # online path is detection latency, not throughput -- how long
+    # after a violating op lands does the monitor's latch flip. Runs
+    # after the timed device rungs (its chunk checks share the chip)
+    rungs["7-monitor-detection"] = _monitor_rung()
 
     # CPU oracles race in parallel subprocesses AFTER all device
     # measurements (their CPU load would pollute the device numbers);

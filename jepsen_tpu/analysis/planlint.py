@@ -29,6 +29,13 @@ Codes:
                  duplicate cell ids (errors); seed collisions or
                  per-cell robustness knobs that trip the PL011 rules
                  (warnings)
+  PL013 mixed    streaming-monitor knobs: non-positive / non-integer
+                 monitor chunk (error); monitor-chunk without monitor,
+                 an unknown monitor engine, a checker family with no
+                 incremental engine (e.g. the cycle checker), or
+                 op-timeout-ms armed alongside the monitor (each
+                 harness-timeout op stays permanently open in the
+                 monitor's incremental encoding) -- warnings
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -47,7 +54,7 @@ from .histlint import model_op_set
 logger = logging.getLogger(__name__)
 
 __all__ = ["lint_plan", "lint_campaign", "preflight", "PlanLintError",
-           "FATAL_CODES"]
+           "FATAL_CODES", "monitor_diags"]
 
 #: error codes certain enough to abort the run before node contact
 FATAL_CODES = {"PL001", "PL003", "PL004", "PL005", "PL006"}
@@ -205,6 +212,75 @@ def lint_plan(test):
 
     # -- robustness knobs (jepsen_tpu.robust) --------------------------
     diags += robustness_knob_diags(test, "PL011", "plan")
+
+    # -- streaming-monitor knobs (jepsen_tpu.monitor) ------------------
+    diags += monitor_diags(test)
+    return diags
+
+
+def monitor_diags(test):
+    """The PL013 rules over a test map's monitor wiring."""
+    diags = []
+    mon = test.get("monitor")
+    if not mon:
+        if test.get("monitor-chunk") is not None:
+            diags.append(diag(
+                "PL013", WARNING,
+                f"monitor-chunk {test['monitor-chunk']!r} is set but "
+                "the monitor is off: the knob is ignored",
+                "plan.monitor-chunk",
+                "enable the monitor (--monitor / test['monitor']) or "
+                "drop the knob"))
+        return diags
+    from .. import monitor as jmonitor
+    from ..monitor import engine as mengine
+    cfg = jmonitor.config(test) or {}
+    chunk = cfg.get("chunk")
+    if chunk is not None and (not isinstance(chunk, int)
+                              or isinstance(chunk, bool) or chunk <= 0):
+        diags.append(diag(
+            "PL013", ERROR,
+            f"monitor chunk must be a positive integer, got {chunk!r}",
+            "plan.monitor.chunk",
+            "the monitor batches this many completed ops per "
+            "incremental check (default 64)"))
+    engine = cfg.get("engine")
+    if engine is not None and engine not in mengine.ENGINES:
+        diags.append(diag(
+            "PL013", WARNING,
+            f"monitor engine {engine!r} is not one of "
+            f"{list(mengine.ENGINES)}: the monitor will fall back to "
+            "its default",
+            "plan.monitor.engine"))
+    checker = test.get("checker")
+    if checker is not None:
+        try:
+            lin, _keyed = jmonitor.find_linearizable(checker)
+        except Exception:  # noqa: BLE001 - reflection is best-effort
+            lin = True
+        if lin is None:
+            diags.append(diag(
+                "PL013", WARNING,
+                "monitor requested but the checker tree has no "
+                "linearizable gate: this checker family (e.g. the "
+                "cycle checker) has no incremental engine, so the "
+                "monitor will disable itself at runtime",
+                "plan.monitor",
+                "monitor workloads checked by checkers.linearizable "
+                "(directly, composed, or under independent)"))
+    ot = test.get("op-timeout-ms")
+    if isinstance(ot, (int, float)) and not isinstance(ot, bool) \
+            and ot > 0:
+        diags.append(diag(
+            "PL013", WARNING,
+            f"op-timeout-ms {ot:g} is armed alongside the monitor: "
+            "every harness-timeout op becomes :info and stays "
+            "permanently open in the monitor's incremental encoding, "
+            "growing each chunk check (same class of interaction "
+            "PL011 flags against the run deadline)",
+            "plan.monitor",
+            "prefer fixing wedged clients over monitoring around "
+            "them, or raise the op timeout"))
     return diags
 
 

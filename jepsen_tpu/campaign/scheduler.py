@@ -115,6 +115,10 @@ def _outcome_of(test, latch):
     if test.get("aborted") and latch.is_set() \
             and str(test["aborted"]) == str(latch.reason):
         return "aborted", valid
+    # a MONITOR-aborted cell ("monitor-violation" on the cell's own
+    # chained latch, never the campaign latch) falls through here on
+    # purpose: its salvaged prefix was checked, so its verdict is a
+    # TERMINAL outcome (normally False) that --resume must not re-run
     if valid is True or valid is False:
         return valid, valid
     return "unknown", valid
@@ -210,6 +214,13 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
                 if test.get("checker") is not None:
                     test["checker"] = _DeviceSlotChecker(
                         test["checker"], sem, reg)
+                if test.get("monitor"):
+                    # monitored cells count against the device slots:
+                    # the monitor's device-engine chunk checks acquire
+                    # the same semaphore as offline searches, so a
+                    # fleet can't oversubscribe the accelerator by
+                    # monitoring every cell at once
+                    test["monitor-device-sem"] = sem
                 finished = run_fn(test)
                 outcome, valid = _outcome_of(finished, latch)
                 rec["outcome"], rec["valid"] = outcome, valid

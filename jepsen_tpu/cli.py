@@ -79,6 +79,17 @@ def add_test_opts(parser):
                         help="How long outstanding ops may drain after "
                              "an abort (SIGINT/SIGTERM/hard deadline) "
                              "before being written off as :info.")
+    parser.add_argument("--monitor", action="store_true",
+                        help="Run the streaming linearizability monitor "
+                             "concurrently with the test: a proven "
+                             "violation aborts the run the moment it is "
+                             "detected instead of after the full offline "
+                             "check (default: off).")
+    parser.add_argument("--monitor-chunk", type=int, default=None,
+                        metavar="N",
+                        help="How many completed ops the monitor batches "
+                             "per incremental check (default: 64; "
+                             "requires --monitor).")
     parser.add_argument("--lint", action="store_true",
                         help="Dry run: statically validate the test plan "
                              "(planlint) and exit without contacting any "
@@ -139,6 +150,16 @@ def test_opt_fn(opts):
         v = opts.pop(flag, None)
         if v is not None:
             opts[key] = v
+    # streaming monitor (jepsen_tpu.monitor): --monitor turns it on,
+    # --monitor-chunk sets the batch size. A bare --monitor-chunk is
+    # deliberately KEPT on the map so planlint PL013 can flag the
+    # ignored knob instead of it vanishing silently.
+    monitor = opts.pop("monitor", False)
+    chunk = opts.pop("monitor-chunk", None)
+    if monitor:
+        opts["monitor"] = {"chunk": chunk} if chunk is not None else True
+    elif chunk is not None:
+        opts["monitor-chunk"] = chunk
     opts.pop("node", None)
     opts.pop("nodes-file", None)
     return opts

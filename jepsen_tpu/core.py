@@ -22,6 +22,7 @@ from . import client as jclient
 from . import control as c
 from . import db as jdb
 from . import history as jhistory
+from . import monitor as jmonitor
 from . import nemesis as jnemesis
 from . import obs
 from . import robust
@@ -296,16 +297,31 @@ def analyze(test):
     ``results["salvaged"] = True`` so readers know the verdict covers
     only what was collected."""
     logger.info("Analyzing...")
+    mv = test.get("monitor-verdict")
+    skip = bool(mv and mv.get("verdict") in (True, False)
+                and (jmonitor.config(test) or {}).get("skip-offline?"))
     with obs.span("analyze"):
         test["history"] = jhistory.index(test.get("history") or [])
-        test["results"] = jchecker.check_safe(
-            test.get("checker") or jchecker.noop(), test, test["history"])
+        if skip:
+            # monitor-verdict handoff: the run opted out of the offline
+            # re-check; the monitor already decided every consumed
+            # prefix with the same engines (doc/monitoring.md)
+            test["results"] = {"valid": mv["verdict"],
+                               "monitor-only": True}
+        else:
+            test["results"] = jchecker.check_safe(
+                test.get("checker") or jchecker.noop(), test,
+                test["history"])
     if test.get("salvaged?") or test.get("aborted"):
         results = test["results"]
         if isinstance(results, dict):
             results["salvaged"] = True
             if test.get("aborted"):
                 results["abort-reason"] = str(test["aborted"])
+    if mv is not None and isinstance(test.get("results"), dict):
+        # persist the monitor's verdict next to the offline one so the
+        # two can be cross-checked from results.json alone
+        test["results"]["monitor"] = mv
     logger.info("Analysis complete")
     if test.get("name"):
         store.save_2(test)
@@ -413,6 +429,17 @@ def run(test):
       time-limit-s    hard harness deadline -> graceful abort
       abort-grace-s   drain window for outstanding ops on abort
 
+    Online monitoring (jepsen_tpu.monitor; optional):
+
+      monitor         True | chunk int | options dict -- run the
+                      streaming linearizability monitor concurrently
+                      with the interpreter; a proven violation aborts
+                      the run immediately (reason "monitor-violation")
+                      and ``results["monitor"]`` records the verdict,
+                      detection index, and detection latency
+      op-sinks        extra per-op subscriber callables for the
+                      interpreter's history tap
+
     SIGINT/SIGTERM abort gracefully (second signal hard-aborts), and on
     ANY abort the partial history is persisted, checked, and marked
     ``results["salvaged"] = True`` rather than discarded; named tests
@@ -431,8 +458,16 @@ def run(test):
                     # plan preflight: fail fast on wiring defects,
                     # before sessions/OS/DB touch any node
                     preflight(test)
-                    latch = test.setdefault("abort",
-                                            robust.AbortLatch())
+                    latch = test.setdefault("abort", robust.AbortLatch())
+                    # the streaming monitor chains a per-run latch over
+                    # test["abort"] (a violation aborts THIS run only,
+                    # never a campaign's shared latch) and subscribes
+                    # to the interpreter's op-sink fan-out. Signals
+                    # keep targeting the BASE latch: in a campaign that
+                    # is the fleet-wide latch (SIGINT must stop every
+                    # cell, monitored or not), and the chained latch
+                    # reads through to it either way
+                    mon = jmonitor.install(test)
                     try:
                         with robust.signal_scope(latch):
                             with with_sessions(test):
@@ -448,9 +483,14 @@ def run(test):
                             # sessions still open: snarfing happened
                             # inside with_db
                     except BaseException as e:
+                        # stop the monitor (no final check: the run is
+                        # already dead) so its verdict-so-far rides the
+                        # salvage path into results.json
+                        jmonitor.finalize(mon, test, finish=False)
                         salvage(test, e)
                         raise
                     finally:
+                        jmonitor.finalize(mon, test)
                         journal = test.pop("journal", None)
                         if journal is not None:
                             journal.close()

@@ -276,6 +276,13 @@ def demo_test(options):
         "leave-db-running?": options.get("leave-db-running?", False),
         "logging-json?": options.get("logging-json?", False),
     }
+    # harness knobs flow straight from the parsed CLI options onto the
+    # test-map keys core.run/interpreter/monitor watch (the robustness
+    # flags previously never reached the demo test map at all)
+    for k in ("op-timeout-ms", "time-limit-s", "abort-grace-s",
+              "monitor", "monitor-chunk"):
+        if options.get(k) is not None:
+            test[k] = options[k]
     if name == "bank":
         # the workload bundle already carries the generator's constants
         test.update({k: workload[k] for k in ("accounts", "total-amount",

@@ -23,6 +23,15 @@ top, each off by default:
 * ``test["partial-history"]`` exposes the live history list and
   ``test["journal"]`` (a `store.HistoryJournal`) receives every op as it
   lands, so an abort -- even SIGKILL -- never discards the history-so-far.
+
+History ops additionally fan out to ``test["op-sinks"]`` -- a list of
+callables invoked once per recorded op, AFTER the single point where
+``__op_serial__`` stripping and zombie-completion dropping happen, so
+every subscriber sees exactly the ops the history holds, in history
+order, on the event-loop thread. The store journal and the streaming
+monitor (jepsen_tpu.monitor) both subscribe this way; sinks must be
+fast, must not mutate the op, and a raising sink is logged and
+detached rather than allowed to take down the run.
 """
 
 from __future__ import annotations
@@ -270,7 +279,14 @@ def _run(test):
     hard_deadline = (_time.monotonic() + time_limit_s) if time_limit_s \
         else None
     grace_s = test.get("abort-grace-s", DEFAULT_ABORT_GRACE_S)
+    # multi-subscriber op tap: journal + any test["op-sinks"] callables
+    # all receive each recorded op exactly once, post serial-strip and
+    # zombie-drop (PR 3 hardwired the journal alone here; the monitor
+    # needs the same feed, so the tap is now a fan-out list)
+    sinks = [s for s in (test.get("op-sinks") or ()) if callable(s)]
     journal = test.get("journal")
+    if journal is not None:
+        sinks.append(journal.append)
     serial_counter = itertools.count(1)
     serials = {}         # thread -> serial of its outstanding op
     inflight_ops = {}    # thread -> the (clean) outstanding invocation
@@ -288,8 +304,13 @@ def _run(test):
 
     def record(op):
         history.append(op)
-        if journal is not None:
-            journal.append(op)
+        for sink in list(sinks):
+            try:
+                sink(op)
+            except Exception:  # noqa: BLE001 - a sink must not kill the run
+                logger.warning("op sink %r failed; detaching it", sink,
+                               exc_info=True)
+                sinks.remove(sink)
 
     def process_completion(op2):
         """The completion half of the loop body, shared by real worker

@@ -1,0 +1,55 @@
+"""Streaming linearizability monitor: online WGL checking over the
+live op stream, with early abort on violation.
+
+The offline pipeline (ROADMAP "millions of ops") records blind for the
+whole run before the checker says a word: a violation committed in the
+first minute surfaces an hour later, after the generator, the drain,
+and the full device search. This package closes that loop while the
+run is still going:
+
+* **stream tap** -- the interpreter's multi-subscriber op-sink list
+  (``test["op-sinks"]``) delivers every history op, already
+  serial-stripped and zombie-filtered, to `Monitor.offer` on the event
+  loop thread. offer() is one deque append: no encoding, no device
+  work, no locks shared with the search (the <=10% interpreter
+  overhead budget is spent here).
+* **incremental encoder** (`stream.StreamEncoder`) -- completed ops
+  are appended into the dense EncodedHistory row format as they land
+  (pairing, fail-drop, and info semantics identical to
+  ``history.encode_history``); still-open invocations materialize as
+  info rows, exactly how the offline checker would see the same
+  prefix. Keyed workloads (jepsen.independent ``[k v]`` tuples) get
+  one encoder per key, mirroring ``independent.subhistory``.
+* **monitor thread** (`core.Monitor`) -- every ``chunk`` completed
+  client ops it materializes the dirty prefixes and extends the WGL
+  verdict through the configured engine (``jax-wgl`` by default: the
+  device search, whose pow-2 padded shapes make the campaign
+  compile-reuse ledger hit across chunk boundaries and runs;
+  ``linear`` / ``wgl`` for CPU-only monitoring). The prefix-check
+  formulation is the sound core of the incremental-monitoring papers
+  (arxiv 2410.04581, 2509.17795): a linearizable prefix can only stay
+  linearizable or become invalid as ops append, so the first invalid
+  prefix IS the violation, and everything before the last valid check
+  never needs re-litigating for the verdict's sake.
+* **violation trigger** -- the moment a prefix proves
+  non-linearizable the monitor flips its `robust.ChainedLatch`
+  (reason ``monitor-violation``): the interpreter stops new ops at
+  the generator boundary, drains, and the normal salvage path
+  persists + re-checks the partial history. ``results["monitor"]``
+  records the verdict, detection index, and detection latency.
+
+`install(test)` wires all of this from ``test["monitor"]`` (True, a
+chunk int, or an options dict) and is called by ``core.run``; the
+monitor discovers the model through the test's own checker tree
+(`find_linearizable`), so it checks exactly what the offline
+Linearizable gate would.
+"""
+
+from __future__ import annotations
+
+from .core import (DEFAULT_CHUNK, Monitor, config, finalize,  # noqa: F401
+                   find_linearizable, install)
+from .stream import StreamEncoder  # noqa: F401
+
+__all__ = ["Monitor", "StreamEncoder", "install", "finalize", "config",
+           "find_linearizable", "DEFAULT_CHUNK"]

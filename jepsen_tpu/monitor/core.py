@@ -1,0 +1,446 @@
+"""The monitor thread: consume the live op stream, extend the WGL
+verdict chunk by chunk, abort the run on violation.
+
+Threading contract:
+
+* ``offer(op)`` runs on the interpreter's event-loop thread for every
+  history op, after serial-stripping and zombie filtering (the op-sink
+  fan-out in interpreter.py). It appends to a deque and occasionally
+  notifies -- the whole per-op cost the interpreter pays.
+* one daemon thread (``jepsen monitor``) drains the deque, feeds the
+  per-key `StreamEncoder`s, and runs a prefix check over every key
+  that saw new completions once ``chunk`` completions accumulated.
+* ``stop()`` is idempotent and bounded: it asks the thread to finish
+  (draining + one final check so the verdict covers everything
+  consumed), joins with a timeout, and cancels a wedged device check
+  through the engines' ``cancel`` event rather than waiting forever.
+
+Verdict semantics: the monitor re-checks the *prefix*, so its False is
+exactly the offline checker's False on the same cut -- the acceptance
+property tests equivalence across chunk sizes. "unknown" checks never
+abort anything; they are counted and the offline checker keeps the
+final word.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import threading
+import time as _time
+
+from .. import independent
+from .. import obs
+from .. import robust
+from ..checker.core import merge_valid
+from . import engine as mengine
+from .stream import StreamEncoder
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DEFAULT_CHUNK", "Monitor", "config", "find_linearizable",
+           "install", "finalize"]
+
+#: completed client ops per monitor step (pow-2 so encoded prefixes
+#: cross shape buckets as rarely as possible)
+DEFAULT_CHUNK = 64
+
+#: bounded join for the monitor thread at stop(); a device check that
+#: outlives this is cancelled, then given a short grace
+STOP_JOIN_S = 60.0
+CANCEL_JOIN_S = 5.0
+
+#: latch reason for monitor-triggered aborts (campaign outcome logic
+#: and docs key off this string)
+ABORT_REASON = "monitor-violation"
+
+
+def config(test):
+    """Normalize ``test["monitor"]`` (True | chunk int | options dict)
+    into an options dict, or None when monitoring is off. Recognized
+    keys: chunk, engine, engine-opts, skip-offline?, final?."""
+    mon = test.get("monitor")
+    if not mon:
+        return None
+    if mon is True:
+        cfg = {}
+    elif isinstance(mon, int) and not isinstance(mon, bool):
+        cfg = {"chunk": mon}
+    elif isinstance(mon, dict):
+        cfg = dict(mon)
+    else:
+        logger.warning("unrecognized test['monitor'] %r: monitoring "
+                       "disabled", mon)
+        return None
+    if test.get("monitor-chunk") is not None:
+        cfg.setdefault("chunk", test["monitor-chunk"])
+    return cfg
+
+
+def find_linearizable(checker):
+    """Walk a checker tree to the Linearizable gate. Returns
+    (linearizable, keyed) -- keyed True when the gate sits under an
+    independent checker (ops carry [k v] tuples) -- or (None, False)
+    when the family has no incremental engine (e.g. the cycle
+    checker)."""
+    from ..checker.checkers import Linearizable
+    seen = set()
+
+    def walk(c, keyed):
+        if c is None or id(c) in seen:
+            return None
+        seen.add(id(c))
+        if isinstance(c, Linearizable):
+            return c, keyed
+        if isinstance(c, independent._IndependentChecker):
+            return walk(c.inner, True)
+        # unwrap the common single-child wrappers (device-slot,
+        # concurrency-limit) by attribute convention
+        for attr in ("inner", "checker"):
+            child = getattr(c, attr, None)
+            if child is not None and child is not c:
+                got = walk(child, keyed)
+                if got is not None:
+                    return got
+        cmap = getattr(c, "checker_map", None)
+        if isinstance(cmap, dict):
+            for child in cmap.values():
+                got = walk(child, keyed)
+                if got is not None:
+                    return got
+        return None
+
+    got = walk(checker, False)
+    return got if got is not None else (None, False)
+
+
+class Monitor:
+    """One run's streaming monitor. Build via `install(test)`."""
+
+    def __init__(self, spec, latch, chunk=DEFAULT_CHUNK,
+                 engine="jax-wgl", engine_opts=None, init_ops=(),
+                 keyed=False, device_sem=None):
+        self.spec = spec
+        self.latch = latch
+        self.chunk = max(1, int(chunk))
+        self.engine = engine
+        self.engine_opts = dict(engine_opts or {})
+        self.init_ops = list(init_ops or ())
+        self.keyed = keyed
+        self.device_sem = device_sem
+        self.violation = None
+        # sinks captured at construction (inside the run's obs scope):
+        # overlapping campaign cells must not cross-attribute monitor
+        # telemetry through the process-global binding
+        self._tr = obs.tracer()
+        self._reg = obs.registry()
+        self._cancel = threading.Event()
+        self._cond = threading.Condition()
+        self._queue = collections.deque()   # (op, index, t_offer)
+        self._pending_completions = 0
+        self._n_seen = 0
+        self._stopping = False
+        self._finish = True
+        self._encoders = {}                 # key -> StreamEncoder
+        self._dirty = {}                    # key -> t_offer of newest op
+        self._verdicts = {}                 # key -> last check validity
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="jepsen monitor")
+        # counters mirrored into the summary (registry may be absent)
+        self.ops_consumed = 0
+        self.chunks = 0
+        self.checks = 0
+        self.unknown_checks = 0
+        self.unkeyed_skipped = 0
+        self._t_start = _time.monotonic()
+        self._t_first_verdict = None
+
+    # -- interpreter side --------------------------------------------------
+
+    def offer(self, op):
+        """Op-sink entry: called on the event-loop thread per history
+        op. O(1); never raises."""
+        try:
+            with self._cond:
+                idx = self._n_seen
+                self._n_seen += 1
+                if self.violation is not None or self._stopping:
+                    return
+                self._queue.append((op, idx, _time.monotonic()))
+                if op.get("type") != "invoke" \
+                        and isinstance(op.get("process"), int):
+                    self._pending_completions += 1
+                    if self._pending_completions >= self.chunk:
+                        self._cond.notify()
+        except Exception:  # noqa: BLE001 - must never hurt the run
+            logger.warning("monitor offer failed", exc_info=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self, finish=True, timeout_s=STOP_JOIN_S):
+        """Ask the thread to wrap up and join (idempotent). With
+        ``finish`` the thread drains the queue and runs one last check
+        over every dirty key, so the summary verdict covers the whole
+        consumed stream; without it (crash paths) the thread exits at
+        the next opportunity."""
+        with self._cond:
+            self._stopping = True
+            self._finish = self._finish and finish
+            self._cond.notify_all()
+        if not self._thread.is_alive():
+            return
+        self._thread.join(timeout_s)
+        if self._thread.is_alive():
+            self._cancel.set()
+            self._thread.join(CANCEL_JOIN_S)
+            if self._thread.is_alive():
+                logger.warning("monitor thread did not exit; abandoning")
+                self._inc("robust.leaked_threads")
+
+    # -- summary -----------------------------------------------------------
+
+    def summary(self):
+        """The ``results["monitor"]`` block."""
+        if self.violation is not None:
+            verdict = False
+        else:
+            verdict = merge_valid(self._verdicts.values()) \
+                if self._verdicts else True
+        out = {
+            "verdict": verdict,
+            "engine": self.engine,
+            "chunk": self.chunk,
+            "ops_consumed": self.ops_consumed,
+            "chunks": self.chunks,
+            "checks": self.checks,
+            "unknown_checks": self.unknown_checks,
+            "keys": len(self._encoders),
+            "time_to_first_verdict_s": self._t_first_verdict,
+        }
+        if self.unkeyed_skipped:
+            out["unkeyed_ops_skipped"] = self.unkeyed_skipped
+        if self.violation is not None:
+            out.update(self.violation)
+        return out
+
+    # -- monitor thread ----------------------------------------------------
+
+    def _inc(self, name, n=1, **labels):
+        if self._reg is not None:
+            self._reg.inc(name, n, **labels)
+
+    def _span(self, name, **args):
+        if self._tr is None:
+            return contextlib.nullcontext()
+        return self._tr.span(name, cat="monitor", args=args or None)
+
+    def _encoder(self, key):
+        enc = self._encoders.get(key)
+        if enc is None:
+            enc = self._encoders[key] = StreamEncoder(
+                self.spec, self.init_ops)
+        return enc
+
+    def _consume(self, op, idx, t):
+        """Feed one event into the right encoder; count completions."""
+        if not isinstance(op.get("process"), int):
+            return
+        if self.keyed:
+            v = op.get("value")
+            if not independent.is_tuple(v):
+                # independent.subhistory replicates un-keyed client ops
+                # into every key; the stream can't (later keys don't
+                # exist yet), so they are skipped and counted --
+                # doc/monitoring.md spells out the caveat
+                self.unkeyed_skipped += 1
+                self._inc("monitor.unkeyed_ops_skipped")
+                return
+            op = dict(op)
+            op["value"] = v.value
+            key = v.key
+        else:
+            key = None
+        enc = self._encoder(key)
+        if enc.offer(op, idx):
+            self.ops_consumed += 1
+            self._inc("monitor.ops_consumed")
+            self._dirty[key] = max(self._dirty.get(key, 0.0), t)
+
+    def _check_key(self, key, t_newest):
+        """Materialize + check one key's prefix; returns its validity
+        and records a violation on False."""
+        enc = self._encoders[key]
+        e, init_state = enc.materialize()
+        t0 = _time.monotonic()
+        sem = self.device_sem if self.engine == "jax-wgl" else None
+        if sem is not None:
+            t_w = _time.monotonic()
+            sem.acquire()
+            self._inc("monitor.device_waits")
+            if self._reg is not None:
+                self._reg.observe("monitor.device_wait_s",
+                                  _time.monotonic() - t_w)
+        try:
+            with self._span("monitor.check", key=repr(key), n=len(e)):
+                r = mengine.check_prefix(
+                    self.spec, e, init_state, self.engine,
+                    self.engine_opts, cancel=self._cancel)
+        finally:
+            if sem is not None:
+                sem.release()
+        dt = _time.monotonic() - t0
+        self.checks += 1
+        valid = r.get("valid")
+        self._inc("monitor.checks", valid=str(valid))
+        if self._reg is not None:
+            self._reg.observe("monitor.check_s", dt)
+        if self._t_first_verdict is None and valid in (True, False):
+            self._t_first_verdict = round(
+                _time.monotonic() - self._t_start, 4)
+            if self._reg is not None:
+                self._reg.set_gauge("monitor.time_to_first_verdict_s",
+                                    self._t_first_verdict)
+        if valid == "unknown":
+            self.unknown_checks += 1
+            # an undecided check leaves the key "unknown" until a
+            # LATER check decides: checks are cumulative prefixes, so
+            # a later True covers every earlier cut (prefix-closure of
+            # linearizability) and overwrites this. Without the
+            # degrade, an all-unknown run would summarize as verdict
+            # True -- and with skip-offline? be recorded valid with
+            # no check ever deciding. False stays sticky (it can
+            # never unhappen, and it already aborted the run).
+            if self._verdicts.get(key) is not False:
+                self._verdicts[key] = "unknown"
+            return "unknown"
+        self._verdicts[key] = valid
+        if valid is False and self.violation is None:
+            latency = max(0.0, _time.monotonic() - t_newest)
+            self.violation = {
+                "detected_at_index": enc.last_index,
+                "detection_latency_s": round(latency, 4),
+                "checked_ops": len(e),
+            }
+            if self.keyed:
+                self.violation["key"] = key
+            w = r.get("op")
+            if isinstance(w, dict):
+                self.violation["detected_op"] = dict(w)
+            self._inc("monitor.violations")
+            if self._reg is not None:
+                self._reg.set_gauge("monitor.detection_latency_s",
+                                    self.violation["detection_latency_s"])
+            if self._tr is not None:
+                self._tr.instant("monitor.violation", cat="monitor",
+                                 args=dict(self.violation,
+                                           detected_op=None))
+            logger.warning(
+                "MONITOR: non-linearizable prefix detected at history "
+                "index %d%s (%.3fs after the op landed); aborting run",
+                enc.last_index,
+                f" key {key!r}" if self.keyed else "", latency)
+            self.latch.set(ABORT_REASON)
+        return valid
+
+    def _step(self):
+        """Drain the queue and check every key that saw new
+        completions (called per chunk, and once more at stop for the
+        final flush)."""
+        with self._cond:
+            batch = list(self._queue)
+            self._queue.clear()
+            self._pending_completions = 0
+        for op, idx, t in batch:
+            self._consume(op, idx, t)
+        if not self._dirty:
+            return
+        self.chunks += 1
+        self._inc("monitor.chunks")
+        dirty, self._dirty = self._dirty, {}
+        for key in sorted(dirty, key=repr):
+            if self.violation is not None or self._cancel.is_set():
+                return
+            self._check_key(key, dirty[key])
+
+    def _run(self):
+        with self._span("monitor.run", engine=self.engine,
+                        chunk=self.chunk):
+            while True:
+                with self._cond:
+                    while (self._pending_completions < self.chunk
+                           and not self._stopping
+                           and self.violation is None):
+                        self._cond.wait(0.25)
+                    stopping = self._stopping
+                if self.violation is not None:
+                    break
+                if stopping:
+                    if self._finish and not self._cancel.is_set():
+                        self._step()
+                    break
+                self._step()
+
+
+def install(test):
+    """Wire a Monitor into a prepared test map (``core.run`` calls
+    this after preflight): discover the Linearizable gate in the
+    test's checker tree, chain a per-run abort latch over
+    ``test["abort"]``, subscribe to the interpreter's op-sink list,
+    and start the thread. Returns the Monitor, or None when
+    monitoring is off/unavailable (never raises)."""
+    cfg = config(test)
+    if cfg is None:
+        return None
+    try:
+        lin, keyed = find_linearizable(test.get("checker"))
+        if lin is None:
+            logger.warning(
+                "monitor requested but the checker tree has no "
+                "linearizable gate (no incremental engine for this "
+                "family); monitoring disabled for this run")
+            obs.inc("monitor.disabled", reason="no-engine")
+            return None
+        engine = cfg.get("engine")
+        if engine is None:
+            engine = lin.algorithm if lin.algorithm in mengine.ENGINES \
+                else "jax-wgl"
+        latch = robust.ChainedLatch(test.get("abort"))
+        test["abort"] = latch
+        mon = Monitor(
+            spec=lin.spec, latch=latch,
+            chunk=cfg.get("chunk") or DEFAULT_CHUNK,
+            engine=engine,
+            engine_opts=cfg.get("engine-opts") or lin.engine_opts,
+            init_ops=lin.init_ops, keyed=keyed,
+            device_sem=test.get("monitor-device-sem"))
+        test.setdefault("op-sinks", []).append(mon.offer)
+        obs.inc("monitor.installed", engine=engine)
+        return mon.start()
+    except Exception:  # noqa: BLE001 - a monitor bug must not kill runs
+        logger.warning("monitor install failed; continuing unmonitored",
+                       exc_info=True)
+        return None
+
+
+def finalize(mon, test, finish=True):
+    """Stop a Monitor and park its summary on the test map
+    (idempotent; ``core.run`` calls it on every exit path before
+    analyze so the verdict lands in results.json + monitor.json)."""
+    if mon is None:
+        return None
+    try:
+        mon.stop(finish=finish)
+        summary = mon.summary()
+        test["monitor-verdict"] = summary
+        sinks = test.get("op-sinks")
+        if isinstance(sinks, list) and mon.offer in sinks:
+            sinks.remove(mon.offer)
+        return summary
+    except Exception:  # noqa: BLE001
+        logger.warning("monitor finalize failed", exc_info=True)
+        return None

@@ -1,0 +1,68 @@
+"""Prefix-check dispatch for the streaming monitor.
+
+One function, three engines -- the same trio the offline
+``Linearizable`` gate races, minus the race (the monitor re-checks
+every chunk, so it wants one predictable engine per run):
+
+* ``jax-wgl`` -- the batched device search. Prefixes pad to the same
+  pow-2 buckets as offline checks (``jax_wgl._bucket`` /
+  ``_n_floor``), so a run's successive chunk checks reuse ONE
+  compiled kernel per bucket, the campaign compile-reuse ledger
+  counts the hits, and the carry advances through the existing
+  ``run_chunk`` donate-argnums dispatch loop.
+* ``linear`` -- just-in-time linearization: the CPU engine whose
+  event-sweep formulation is itself the incremental-monitoring
+  algorithm of the papers; the natural choice for CPU-only runs.
+* ``wgl`` -- the sequential oracle, for tests and tiny histories.
+
+Budgets are deliberately modest: a monitor check that can't decide
+quickly returns "unknown" and the monitor moves on -- the offline
+checker still owns the final word; the monitor only ever *adds* an
+early abort.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ENGINES", "check_prefix"]
+
+#: engines the monitor can drive (planlint PL013 validates against it)
+ENGINES = ("jax-wgl", "linear", "wgl")
+
+#: CPU-engine budgets: chunk checks repeat, so each one must stay small
+LINEAR_MAX_CONFIGS = 200_000
+WGL_MAX_CONFIGS = 2_000_000
+
+
+def check_prefix(spec, e, init_state, engine="jax-wgl",
+                 engine_opts=None, cancel=None):
+    """Check one encoded prefix; returns the engine's result dict
+    ({"valid": True|False|"unknown", ...}). Exceptions become
+    "unknown": a monitor bug must never abort a healthy run."""
+    if len(e) == 0 or e.n_ok == 0:
+        return {"valid": True, "configs_explored": 0, "engine": engine}
+    try:
+        if engine == "linear":
+            from ..checker import linear
+            return linear.check_encoded(
+                spec, e, init_state, max_configs=LINEAR_MAX_CONFIGS,
+                cancel=cancel)
+        if engine == "wgl":
+            from ..checker import wgl
+            return wgl.check_encoded(
+                spec, e, init_state, max_configs=WGL_MAX_CONFIGS,
+                cancel=cancel)
+        from ..checker import jax_wgl
+        opts = dict(engine_opts or {})
+        # the mesh/checkpoint machinery is offline-only; a monitor
+        # check is short-lived and re-runs every chunk
+        for k in ("mesh", "checkpoint", "checkpoint_every_s", "confirm"):
+            opts.pop(k, None)
+        return jax_wgl.check_encoded(spec, e, init_state, cancel=cancel,
+                                     **opts)
+    except Exception as exc:  # noqa: BLE001 - contained per check
+        logger.warning("monitor prefix check crashed", exc_info=True)
+        return {"valid": "unknown", "error": repr(exc), "engine": engine}

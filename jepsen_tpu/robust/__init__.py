@@ -33,9 +33,9 @@ preserved byte-for-byte on the happy path.
 
 from __future__ import annotations
 
-from .abort import AbortLatch, signal_scope
+from .abort import AbortLatch, ChainedLatch, signal_scope
 from .retry import RetryPolicy
 from .watchdog import OpWatchdog, WATCHDOG_FIRED
 
-__all__ = ["AbortLatch", "signal_scope", "RetryPolicy", "OpWatchdog",
-           "WATCHDOG_FIRED"]
+__all__ = ["AbortLatch", "ChainedLatch", "signal_scope", "RetryPolicy",
+           "OpWatchdog", "WATCHDOG_FIRED"]

@@ -24,7 +24,7 @@ import threading
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["AbortLatch", "signal_scope"]
+__all__ = ["AbortLatch", "ChainedLatch", "signal_scope"]
 
 
 class AbortLatch:
@@ -66,6 +66,56 @@ class AbortLatch:
         with self._lock:
             self._signals += 1
             return self._signals
+
+
+class ChainedLatch(AbortLatch):
+    """A per-run latch layered over a shared parent latch.
+
+    The streaming monitor must be able to abort ITS run without
+    touching anyone else's: in a campaign every cell shares one
+    `AbortLatch` (SIGINT stops the fleet), so a monitor flipping that
+    shared latch on one cell's violation would tear down every
+    sibling. A ChainedLatch reports set when EITHER it or its parent
+    fired, with the own reason winning (a monitor violation is more
+    specific than a concurrent fleet-wide SIGINT), so the interpreter
+    polls one object and both abort sources work.
+
+    Signal-safety is inherited: set/note_signal only touch this
+    latch's own RLock; the parent is only ever *read*."""
+
+    def __init__(self, parent=None):
+        super().__init__()
+        self.parent = parent
+
+    def is_set(self):
+        return super().is_set() or (self.parent is not None
+                                    and self.parent.is_set())
+
+    @property
+    def reason(self):
+        own = AbortLatch.reason.fget(self)
+        if own is not None:
+            return own
+        return self.parent.reason if self.parent is not None else None
+
+    def wait(self, timeout=None):
+        """Poll-wait across both latches (the own event can't see the
+        parent fire). Slices are short; callers of wait() are never on
+        a hot path."""
+        if self.parent is None:
+            return self._event.wait(timeout)
+        import time as _time
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        while True:
+            if self.is_set():
+                return True
+            left = None if deadline is None \
+                else deadline - _time.monotonic()
+            if left is not None and left <= 0:
+                return False
+            self._event.wait(min(0.05, left) if left is not None
+                             else 0.05)
 
 
 @contextlib.contextmanager

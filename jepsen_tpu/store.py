@@ -39,6 +39,7 @@ DEFAULT_NONSERIALIZABLE_KEYS = {
     "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
     "remote", "barrier", "sessions", "dummy-log", "obs",
     "analysis-done?", "abort", "journal", "partial-history",
+    "op-sinks", "monitor-device-sem",
 }
 
 #: on-disk name of the incremental history journal (one JSON op per
@@ -221,6 +222,7 @@ def write_test(test):
     t.pop("history", None)   # stored separately as history.jsonl
     t.pop("results", None)   # stored separately as results.json
     t.pop("analysis", None)  # stored separately as analysis.json
+    t.pop("monitor-verdict", None)  # stored separately as monitor.json
     _dump_json(t, make_path(test, "test.json"))
 
 
@@ -272,6 +274,15 @@ def write_obs(test):
         logger.warning("couldn't write obs artifacts", exc_info=True)
 
 
+def write_monitor(test):
+    """Writes monitor.json -- the streaming monitor's verdict block
+    (verdict, detection index, detection latency, chunk/check counts)
+    next to results.json. No file for unmonitored runs."""
+    mv = test.get("monitor-verdict")
+    if mv:
+        _dump_json(mv, make_path(test, "monitor.json"))
+
+
 def write_analysis(test):
     """Writes analysis.json: the static-diagnostic reports accumulated
     on the test map (planlint preflight, histlint) -- see
@@ -289,6 +300,7 @@ def save_1(test):
     write_test(test)
     write_obs(test)
     write_analysis(test)
+    write_monitor(test)
     update_symlinks(test)
     return test
 
@@ -305,6 +317,7 @@ def save_2(test):
     write_history(test)
     write_test(test)
     write_analysis(test)   # histlint findings exist only after analyze
+    write_monitor(test)
     update_symlinks(test)
     return test
 

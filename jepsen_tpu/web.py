@@ -41,9 +41,34 @@ def _valid_class(valid):
     return "valid-unknown"
 
 
+def _monitor_header(path):
+    """The monitor.json verdict header for a run dir, or None."""
+    try:
+        with open(path) as f:
+            mv = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(mv, dict):
+        return None
+    return {"verdict": mv.get("verdict"),
+            "index": mv.get("detected_at_index"),
+            "latency": mv.get("detection_latency_s")}
+
+
+def _monitor_cell(mon):
+    """Render the home-table monitor column for one run."""
+    if mon is None:
+        return ""
+    if mon["verdict"] is False:
+        return (f"violation @{html.escape(str(mon['index']))} "
+                f"({html.escape(str(mon['latency']))}s)")
+    return html.escape(str(mon["verdict"]))
+
+
 def _fast_tests():
     """Test rows from results.json headers only (web.clj:48-69), plus
-    which observability/analysis artifacts each run has on disk."""
+    which observability/analysis artifacts each run has on disk and
+    the streaming monitor's verdict when the run was monitored."""
     rows = []
     for name in store.test_names():
         for t in sorted(store.tests(name), reverse=True):
@@ -55,10 +80,12 @@ def _fast_tests():
                 valid = "incomplete"
             fake = {"name": name, "start-time": t}
             obs_files = [f for f in ("trace.jsonl", "metrics.json",
-                                     "analysis.json")
+                                     "analysis.json", "monitor.json")
                          if os.path.exists(store.path(fake, f))]
+            mon = _monitor_header(store.path(fake, "monitor.json")) \
+                if "monitor.json" in obs_files else None
             rows.append({"name": name, "time": t, "valid": valid,
-                         "obs": obs_files})
+                         "obs": obs_files, "monitor": mon})
     rows.sort(key=lambda r: r["time"], reverse=True)
     return rows
 
@@ -77,6 +104,7 @@ def _home_page():
             f'<td>{html.escape(t["name"])}</td>'
             f'<td><a href="{link}">{html.escape(t["time"])}</a></td>'
             f'<td>{html.escape(str(t["valid"]))}</td>'
+            f'<td>{_monitor_cell(t.get("monitor"))}</td>'
             f'<td>{obs_links}</td>'
             f'<td><a href="{zip_link}">zip</a></td></tr>')
     return f"""<html><head><style>{STYLE}</style>
@@ -84,7 +112,7 @@ def _home_page():
 <h1>Jepsen</h1>
 <p><a href="/campaigns">Campaigns</a></p>
 <table><thead><tr><th>Test</th><th>Time</th><th>Valid?</th>
-<th>Observability</th><th></th>
+<th>Monitor</th><th>Observability</th><th></th>
 </tr></thead><tbody>{''.join(rows)}</tbody></table></body></html>"""
 
 
@@ -162,8 +190,23 @@ def _dir_page(rel, full):
         slash = "/" if os.path.isdir(p) else ""
         items.append(f'<li><a href="{urllib.parse.quote(e)}{slash}">'
                      f"{html.escape(e)}{slash}</a></li>")
+    # per-run monitor banner: a monitored run's verdict + detection
+    # index belong on the page, not just inside monitor.json
+    banner = ""
+    mon = _monitor_header(os.path.join(full, "monitor.json")) \
+        if "monitor.json" in entries else None
+    if mon is not None:
+        if mon["verdict"] is False:
+            banner = (f"<p><b>monitor: violation</b> at history index "
+                      f"{html.escape(str(mon['index']))}, detected "
+                      f"{html.escape(str(mon['latency']))}s after the "
+                      f"op landed</p>")
+        else:
+            banner = (f"<p>monitor: {html.escape(str(mon['verdict']))}"
+                      "</p>")
     return f"""<html><head><style>{STYLE}</style></head><body>
-<h1>/{html.escape(rel)}</h1><ul>{''.join(items)}</ul></body></html>"""
+<h1>/{html.escape(rel)}</h1>{banner}<ul>{''.join(items)}</ul>
+</body></html>"""
 
 
 def _zip_dir(full):

@@ -1,0 +1,655 @@
+"""Streaming linearizability monitor (jepsen_tpu/monitor/): incremental
+encoder equivalence, the chunk-size-independent verdict property,
+end-to-end early abort through core.run, SIGKILL consistency with
+salvage, campaign terminal outcomes, the interpreter's multi-subscriber
+op tap, and planlint PL013."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import analysis
+from jepsen_tpu import client as jc
+from jepsen_tpu import checker as cc
+from jepsen_tpu import core
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu import interpreter, nemesis, store
+from jepsen_tpu import monitor as jmon
+from jepsen_tpu.checker import checkers as cks
+from jepsen_tpu.checker import jax_wgl, wgl
+from jepsen_tpu.models import base as mbase
+from jepsen_tpu.monitor.stream import StreamEncoder
+from jepsen_tpu.robust import AbortLatch, ChainedLatch
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+
+
+SPEC = mbase.model_spec("cas-register")
+
+
+def _history(falsify_at=None):
+    """A deterministic 2-process cas-register history (~36 events):
+    sequential writes/reads/cas that a real register would produce;
+    ``falsify_at`` replaces that read's value with 77 (never written),
+    making the history definitively non-linearizable from that point."""
+    value = None
+    events = []
+    reads = 0
+    for i in range(12):
+        p = i % 2
+        if i % 3 == 0:
+            value = i + 1
+            events.append({"type": "invoke", "process": p, "f": "write",
+                           "value": value})
+            events.append({"type": "ok", "process": p, "f": "write",
+                           "value": value})
+        elif i % 3 == 1:
+            v = value
+            reads += 1
+            if falsify_at is not None and reads == falsify_at:
+                v = 77
+            events.append({"type": "invoke", "process": p, "f": "read",
+                           "value": None})
+            events.append({"type": "ok", "process": p, "f": "read",
+                           "value": v})
+        else:
+            old, new = value, value + 100
+            events.append({"type": "invoke", "process": p, "f": "cas",
+                           "value": [old, new]})
+            events.append({"type": "ok", "process": p, "f": "cas",
+                           "value": [old, new]})
+            value = new
+    return events
+
+
+def _feed(mon, hist):
+    for op in hist:
+        mon.offer(op)
+
+
+# ---------------------------------------------------------------------------
+# stream encoder: incremental encoding == offline encoding
+
+
+def test_stream_encoder_matches_offline_encoding():
+    from jepsen_tpu import history as h
+    hist = _history()
+    enc = StreamEncoder(SPEC)
+    for i, op in enumerate(hist):
+        enc.offer(op, i)
+    e, st = enc.materialize()
+    e2, st2 = SPEC.encode(h.index([h.Op(o) for o in hist]))
+    assert len(e) == len(e2)
+    assert (e.f == e2.f).all()
+    assert (e.args == e2.args).all()
+    assert (e.ret == e2.ret).all()
+    assert (e.is_ok == e2.is_ok).all()
+    # invoke/return indices re-rank inside the engines; relative order
+    # is what must agree
+    import numpy as np
+    assert (np.argsort(e.invoke_idx) == np.argsort(e2.invoke_idx)).all()
+    assert (st == st2).all()
+
+
+def test_stream_encoder_fail_drop_and_info_and_open():
+    enc = StreamEncoder(SPEC)
+    ops = [
+        {"type": "invoke", "process": 0, "f": "write", "value": 1},
+        {"type": "ok", "process": 0, "f": "write", "value": 1},
+        {"type": "invoke", "process": 1, "f": "cas", "value": [9, 9]},
+        {"type": "fail", "process": 1, "f": "cas", "value": [9, 9]},
+        {"type": "invoke", "process": 2, "f": "write", "value": 2},
+        {"type": "info", "process": 2, "f": "write", "value": 2},
+        {"type": "invoke", "process": 3, "f": "read", "value": None},
+        # process 3 stays open
+    ]
+    for i, op in enumerate(ops):
+        enc.offer(op, i)
+    e, _ = enc.materialize()
+    # fail dropped; ok + info + open-invoke remain
+    assert len(e) == 3
+    assert e.n_ok == 1
+    from jepsen_tpu.history import INF_TIME
+    assert sorted(e.return_idx.tolist()) == [1, INF_TIME, INF_TIME]
+
+
+def test_stream_encoder_init_ops():
+    enc = StreamEncoder(SPEC, init_ops=[{"f": "write", "value": 0}])
+    ops = [{"type": "invoke", "process": 0, "f": "read", "value": None},
+           {"type": "ok", "process": 0, "f": "read", "value": 0}]
+    for i, op in enumerate(ops):
+        enc.offer(op, i)
+    e, st = enc.materialize()
+    r = wgl.check_encoded(SPEC, e, st)
+    assert r["valid"] is True   # read 0 only valid because of init write
+
+
+# ---------------------------------------------------------------------------
+# THE equivalence property: monitor verdict == offline verdict, for
+# valid AND invalid histories, across chunk sizes 1/8/64
+
+
+@pytest.mark.parametrize("chunk", [1, 8, 64])
+@pytest.mark.parametrize("falsify", [None, 4])
+def test_monitor_matches_offline_jax_wgl(chunk, falsify):
+    hist = _history(falsify_at=falsify)
+    e, st = SPEC.encode([dict(o, index=i) for i, o in enumerate(hist)])
+    offline = jax_wgl.check_encoded(SPEC, e, st)
+    assert offline["valid"] in (True, False)
+
+    latch = ChainedLatch()
+    mon = jmon.Monitor(SPEC, latch, chunk=chunk, engine="wgl").start()
+    _feed(mon, hist)
+    mon.stop()
+    s = mon.summary()
+    assert s["verdict"] is offline["valid"], (s, offline)
+    if offline["valid"] is False:
+        assert latch.is_set()
+        assert latch.reason == "monitor-violation"
+        assert isinstance(s["detected_at_index"], int)
+        assert s["detection_latency_s"] is not None
+    else:
+        assert not latch.is_set()
+
+
+def test_monitor_device_engine_agrees():
+    """One pass on the real jax-wgl engine: the monitor's chunk checks
+    run the device search over pow-2 padded prefixes."""
+    hist = _history(falsify_at=4)
+    latch = ChainedLatch()
+    mon = jmon.Monitor(SPEC, latch, chunk=8, engine="jax-wgl").start()
+    _feed(mon, hist)
+    mon.stop()
+    assert mon.summary()["verdict"] is False
+
+
+def test_monitor_keyed_streams():
+    """Independent [k v] tuples split into per-key encoders; the
+    violation names its key."""
+    t = independent.tuple_
+    ops = []
+    for k in ("a", "b"):
+        ops += [
+            {"type": "invoke", "process": 0, "f": "write",
+             "value": t(k, 1)},
+            {"type": "ok", "process": 0, "f": "write", "value": t(k, 1)},
+            {"type": "invoke", "process": 1, "f": "read",
+             "value": t(k, None)},
+            {"type": "ok", "process": 1, "f": "read",
+             "value": t(k, 1 if k == "a" else 42)},
+        ]
+    latch = ChainedLatch()
+    mon = jmon.Monitor(SPEC, latch, chunk=1, engine="wgl",
+                       keyed=True).start()
+    _feed(mon, ops)
+    mon.stop()
+    s = mon.summary()
+    assert s["verdict"] is False
+    assert s["key"] == "b"
+    assert s["keys"] == 2
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: violation aborts the run before the generator is done
+
+
+class StaleRegister(jc.Client):
+    """Applies the first ``apply_n`` writes, silently drops the rest
+    (acked-but-lost): reads then expose staleness."""
+
+    def __init__(self, apply_n=3):
+        self.apply_n = apply_n
+        self.value = None
+        self.n = 0
+        self.lock = threading.Lock()
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        out = dict(op)
+        with self.lock:
+            if op["f"] == "write":
+                self.n += 1
+                if self.n <= self.apply_n:
+                    self.value = op["value"]
+                out["type"] = "ok"
+            else:
+                out["type"] = "ok"
+                out["value"] = self.value
+        return out
+
+
+def _wr_gen():
+    import itertools
+    c = itertools.count(1)
+
+    def g(test, ctx):
+        n = next(c)
+        if n % 2:
+            return {"type": "invoke", "f": "write", "value": n}
+        return {"type": "invoke", "f": "read"}
+
+    return g
+
+
+def _violating_test(**kw):
+    t = {"name": "monitor-abort", "nodes": ["n1"], "concurrency": 1,
+         "ssh": {"dummy?": True}, "client": StaleRegister(),
+         "monitor": {"chunk": 4, "engine": "wgl"},
+         "time-limit-s": 60,
+         "generator": gen.clients(_wr_gen()),
+         "checker": cks.linearizable({"model": "cas-register",
+                                      "algorithm": "wgl"})}
+    t.update(kw)
+    return t
+
+
+def test_monitor_aborts_run_and_offline_reproduces():
+    t0 = time.monotonic()
+    test = core.run(_violating_test())
+    assert time.monotonic() - t0 < 30   # the generator is endless
+    assert test["aborted"] == "monitor-violation"
+    r = test["results"]
+    assert r["salvaged"] is True
+    assert r["abort-reason"] == "monitor-violation"
+    m = r["monitor"]
+    assert m["verdict"] is False
+    assert isinstance(m["detected_at_index"], int)
+    assert m["detection_latency_s"] is not None
+    # replaying the salvaged history through the offline checker
+    # reproduces the invalid verdict
+    assert r["valid"] is False
+    d = store.path(test)
+    hist = store.load_history({"name": test["name"],
+                               "start-time": test["start-time"]})
+    e, st = SPEC.encode(hist)
+    assert wgl.check_encoded(SPEC, e, st)["valid"] is False
+    with open(os.path.join(d, "monitor.json")) as f:
+        assert json.load(f)["verdict"] is False
+    # test.json keeps the monitor config but not the verdict blob
+    with open(os.path.join(d, "test.json")) as f:
+        tj = json.load(f)
+    assert "monitor-verdict" not in tj
+    assert tj.get("monitor") == {"chunk": 4, "engine": "wgl"}
+
+
+def test_monitor_clean_run_stays_clean():
+    """A healthy monitored run completes normally with verdict True
+    and no abort."""
+    test = core.run(_violating_test(
+        client=StaleRegister(apply_n=10**9),
+        generator=gen.clients(gen.limit(20, _wr_gen()))))
+    assert not test.get("aborted")
+    r = test["results"]
+    assert r["valid"] is True
+    assert r["monitor"]["verdict"] is True
+    assert r["monitor"]["ops_consumed"] >= 20
+    assert "salvaged" not in r
+
+
+def test_monitor_skip_offline_handoff():
+    test = core.run(_violating_test(
+        monitor={"chunk": 4, "engine": "wgl", "skip-offline?": True}))
+    r = test["results"]
+    assert r["valid"] is False
+    assert r["monitor-only"] is True
+    assert r["monitor"]["verdict"] is False
+
+
+def test_monitor_disables_without_linearizable_gate():
+    """A checker family with no incremental engine: the monitor
+    disables itself and the run completes untouched."""
+    test = core.run(_violating_test(
+        checker=cc.unbridled_optimism(),
+        generator=gen.clients(gen.limit(10, _wr_gen()))))
+    assert not test.get("aborted")
+    assert "monitor" not in test["results"]
+
+
+def test_all_unknown_checks_degrade_verdict(monkeypatch):
+    """A monitor that never decided must summarize "unknown", never
+    True -- with skip-offline? that summary would otherwise be
+    recorded as the run's validity with no check ever deciding."""
+    from jepsen_tpu.monitor import engine as mengine
+
+    monkeypatch.setattr(
+        mengine, "check_prefix",
+        lambda *a, **kw: {"valid": "unknown", "error": "budget"})
+    import jepsen_tpu.monitor.core as mcore
+    latch = ChainedLatch()
+    mon = mcore.Monitor(SPEC, latch, chunk=1, engine="wgl").start()
+    _feed(mon, _history())
+    mon.stop()
+    s = mon.summary()
+    assert s["verdict"] == "unknown"
+    assert s["unknown_checks"] > 0
+    assert not latch.is_set()
+
+
+def test_later_definite_check_covers_earlier_unknown(monkeypatch):
+    """Prefix-closure: a later True re-decides a key whose earlier
+    chunk overflowed to "unknown"."""
+    from jepsen_tpu.monitor import engine as mengine
+    real = mengine.check_prefix
+    flaky = {"n": 0}
+
+    def sometimes_unknown(*a, **kw):
+        flaky["n"] += 1
+        if flaky["n"] == 1:
+            return {"valid": "unknown", "error": "budget"}
+        return real(*a, **kw)
+
+    monkeypatch.setattr(mengine, "check_prefix", sometimes_unknown)
+    latch = ChainedLatch()
+    import jepsen_tpu.monitor.core as mcore
+    mon = mcore.Monitor(SPEC, latch, chunk=1, engine="wgl").start()
+    hist = _history()
+    _feed(mon, hist[:8])
+    deadline = time.monotonic() + 10
+    while mon.checks < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert mon.checks >= 1
+    _feed(mon, hist[8:])
+    mon.stop()
+    s = mon.summary()
+    assert s["verdict"] is True
+    assert s["unknown_checks"] == 1
+
+
+def test_external_abort_still_works_on_monitored_run():
+    """Flipping the BASE latch (campaign SIGINT path) aborts a
+    monitored run with the external reason, not the monitor's."""
+    base = AbortLatch()
+    timer = threading.Timer(0.5, base.set, args=("SIGINT",))
+    timer.start()
+    try:
+        test = core.run(_violating_test(
+            client=StaleRegister(apply_n=10**9), abort=base,
+            name="mon-ext-abort"))
+    finally:
+        timer.cancel()
+    assert test["aborted"] == "SIGINT"
+    r = test["results"]
+    assert r["salvaged"] is True
+    assert r["abort-reason"] == "SIGINT"
+    # the monitor saw only a valid prefix
+    assert r["monitor"]["verdict"] is True
+
+
+# ---------------------------------------------------------------------------
+# chained latch
+
+
+def test_chained_latch_parent_and_own():
+    parent = AbortLatch()
+    chained = ChainedLatch(parent)
+    assert not chained.is_set()
+    parent.set("SIGINT")
+    assert chained.is_set()
+    assert chained.reason == "SIGINT"
+    chained.set("monitor-violation")
+    assert chained.reason == "monitor-violation"   # own reason wins
+    assert not parent.is_set() or parent.reason == "SIGINT"
+
+
+def test_chained_latch_does_not_leak_to_parent():
+    parent = AbortLatch()
+    chained = ChainedLatch(parent)
+    chained.set("monitor-violation")
+    assert chained.is_set()
+    assert not parent.is_set()
+    assert chained.wait(0.01)
+
+
+# ---------------------------------------------------------------------------
+# interpreter op-sink fan-out (the tap refactor)
+
+
+def test_op_sinks_fan_out_with_journal():
+    seen = []
+    t = {"name": "tap", "start-time": store.local_time(),
+         "concurrency": 2, "nodes": ["n1"],
+         "client": StaleRegister(apply_n=10**9),
+         "nemesis": nemesis.noop,
+         "op-sinks": [seen.append],
+         "generator": gen.clients(gen.limit(6, gen.repeat(
+             {"f": "read"})))}
+    t["journal"] = store.open_journal(t)
+    h = interpreter.run(t)
+    t["journal"].close()
+    assert seen == h
+    assert all("__op_serial__" not in o for o in seen)
+    with open(store.path(t, store.JOURNAL_FILE)) as f:
+        assert len(f.readlines()) == len(h)
+
+
+def test_raising_sink_is_detached_not_fatal():
+    calls = []
+
+    def bad_sink(op):
+        calls.append(op)
+        raise RuntimeError("sink boom")
+
+    t = {"concurrency": 1, "nodes": ["n1"],
+         "client": StaleRegister(apply_n=10**9),
+         "nemesis": nemesis.noop, "op-sinks": [bad_sink],
+         "generator": gen.clients(gen.limit(4, gen.repeat(
+             {"f": "read"})))}
+    h = interpreter.run(t)
+    assert len(h) == 8
+    assert len(calls) == 1   # detached after the first raise
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-run: journal + monitor state consistent with salvage
+
+
+_KILL9_CHILD = """
+import os, sys, time, itertools
+sys.path.insert(0, sys.argv[2])
+from jepsen_tpu import client as jc, core, generator as gen, store
+from jepsen_tpu.checker import checkers as cks
+store.base_dir = sys.argv[1]
+
+class SlowClient(jc.Client):
+    def invoke(self, test, op):
+        time.sleep(0.01)
+        out = dict(op)
+        out["type"] = "ok"
+        out["value"] = None
+        return out
+
+core.run({"name": "kill9-mon", "nodes": ["n1"], "concurrency": 1,
+          "ssh": {"dummy?": True}, "client": SlowClient(), "obs?": False,
+          "monitor": {"chunk": 2, "engine": "wgl"},
+          "checker": cks.linearizable({"model": "cas-register",
+                                       "algorithm": "wgl"}),
+          "generator": gen.clients(gen.repeat({"f": "read"}))})
+"""
+
+
+def test_kill9_monitored_run_salvageable(tmp_path):
+    base = str(tmp_path / "store")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               JEPSEN_PYTEST_TIMEOUT_S="0")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _KILL9_CHILD, base, repo],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        pattern = os.path.join(base, "kill9-mon", "*",
+                               store.JOURNAL_FILE)
+        deadline = time.monotonic() + 60
+        journal = None
+        while time.monotonic() < deadline:
+            hits = glob.glob(pattern)
+            if hits and os.path.getsize(hits[0]) > 400:
+                journal = hits[0]
+                break
+            time.sleep(0.05)
+        assert journal, "child never journaled any ops"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+
+    run_dir = os.path.dirname(journal)
+    # nothing finalized: the journal is the only history artifact, and
+    # no half-written monitor verdict may shadow the salvage story
+    assert not os.path.exists(os.path.join(run_dir, "history.jsonl"))
+    assert not os.path.exists(os.path.join(run_dir, "monitor.json"))
+    with open(journal) as f:
+        ops = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(ops) >= 2
+    # the journaled prefix replays through the offline checker exactly
+    # like any salvaged history (reads of None against an empty
+    # register are valid)
+    e, st = SPEC.encode([dict(o, index=i) for i, o in enumerate(ops)])
+    assert wgl.check_encoded(SPEC, e, st)["valid"] is True
+
+
+# ---------------------------------------------------------------------------
+# campaign: monitor abort is a terminal outcome; --resume skips it
+
+
+def test_campaign_monitor_abort_terminal_and_not_resumed():
+    from jepsen_tpu import campaign
+    built = {"bad": 0, "good": 0}
+
+    def build_bad(params):
+        built["bad"] += 1
+        return _violating_test(name="cell-bad")
+
+    def build_good(params):
+        built["good"] += 1
+        return _violating_test(
+            name="cell-good", client=StaleRegister(apply_n=10**9),
+            generator=gen.clients(gen.limit(10, _wr_gen())))
+
+    cells = [{"id": "bad", "build": build_bad, "params": {}},
+             {"id": "good", "build": build_good, "params": {}}]
+    report = campaign.run_cells(cells, campaign_id="mon-camp",
+                                parallel=1)
+    recs = {r["cell"]: r
+            for r in store.latest_campaign_records("mon-camp")}
+    assert recs["bad"]["outcome"] is False
+    assert recs["bad"]["abort-reason"] == "monitor-violation"
+    assert recs["good"]["outcome"] is True
+    assert report["status"] == "complete"
+
+    # resume: both cells are terminal; neither builds again
+    before = dict(built)
+    campaign.run_cells(cells, campaign_id="mon-camp", parallel=1,
+                       resume=True)
+    assert built == before
+
+
+def test_campaign_monitored_cell_uses_device_slot(monkeypatch):
+    """The scheduler hands monitored cells the device-slot semaphore."""
+    from jepsen_tpu import campaign
+    seen = {}
+
+    def fake_run(test):
+        seen["sem"] = test.get("monitor-device-sem")
+        test["results"] = {"valid": True}
+        return test
+
+    cells = [{"id": "c", "test": _violating_test(
+        name="slotted", generator=gen.clients(gen.limit(2, _wr_gen())))}]
+    campaign.run_cells(cells, campaign_id="slot-camp", parallel=1,
+                       run_fn=fake_run)
+    assert seen["sem"] is not None
+    assert hasattr(seen["sem"], "acquire")
+
+
+# ---------------------------------------------------------------------------
+# planlint PL013
+
+
+def _plan(**kw):
+    t = {"name": "pl013", "nodes": ["n1"], "concurrency": 1,
+         "ssh": {"dummy?": True}, "client": StaleRegister(),
+         "generator": gen.clients(gen.limit(1, gen.repeat(
+             {"f": "read"}))),
+         "checker": cks.linearizable({"model": "cas-register",
+                                      "algorithm": "wgl"})}
+    t.update(kw)
+    return core.prepare_test(t)
+
+
+def _codes(diags, severity=None):
+    return [d.code for d in diags
+            if severity is None or d.severity == severity]
+
+
+def test_pl013_non_positive_chunk_is_error():
+    diags = analysis.lint_plan(_plan(monitor={"chunk": 0}))
+    assert "PL013" in _codes(diags, "error")
+    diags = analysis.lint_plan(_plan(monitor={"chunk": -3}))
+    assert "PL013" in _codes(diags, "error")
+    diags = analysis.lint_plan(_plan(monitor={"chunk": 2.5}))
+    assert "PL013" in _codes(diags, "error")
+
+
+def test_pl013_orphan_chunk_warns():
+    diags = analysis.lint_plan(_plan(**{"monitor-chunk": 8}))
+    assert "PL013" in _codes(diags, "warning")
+
+
+def test_pl013_no_incremental_engine_warns():
+    diags = analysis.lint_plan(_plan(monitor=True,
+                                     checker=cc.unbridled_optimism()))
+    assert "PL013" in _codes(diags, "warning")
+
+
+def test_pl013_unknown_engine_warns():
+    diags = analysis.lint_plan(_plan(monitor={"engine": "pallas"}))
+    assert "PL013" in _codes(diags, "warning")
+
+
+def test_pl013_op_timeout_interaction_warns():
+    diags = analysis.lint_plan(_plan(monitor=True,
+                                     **{"op-timeout-ms": 500,
+                                        "time-limit-s": 60}))
+    assert "PL013" in _codes(diags, "warning")
+
+
+def test_pl013_clean_monitor_plan():
+    diags = analysis.lint_plan(_plan(monitor={"chunk": 64,
+                                              "engine": "jax-wgl"}))
+    assert "PL013" not in _codes(diags)
+
+
+def test_monitor_config_normalization():
+    assert jmon.config({}) is None
+    assert jmon.config({"monitor": True}) == {}
+    assert jmon.config({"monitor": 16}) == {"chunk": 16}
+    assert jmon.config({"monitor": {"chunk": 8}}) == {"chunk": 8}
+    assert jmon.config({"monitor": True,
+                        "monitor-chunk": 32}) == {"chunk": 32}
+
+
+def test_find_linearizable_walks_wrappers():
+    lin = cks.linearizable({"model": "cas-register"})
+    comp = cc.compose({"workload": independent.checker(
+        cc.compose({"linearizable": lin, "stats": cks.stats()})),
+        "stats": cks.stats()})
+    got, keyed = jmon.find_linearizable(comp)
+    assert got is lin
+    assert keyed is True
+    got, keyed = jmon.find_linearizable(lin)
+    assert got is lin
+    assert keyed is False
+    got, keyed = jmon.find_linearizable(cks.stats())
+    assert got is None

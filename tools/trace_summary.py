@@ -7,7 +7,9 @@ next to results.json) and prints:
 * op-latency quantiles (p50/p90/p99) from the interpreter's op spans,
   falling back to the metrics histogram when the trace has no op spans,
 * op counts by f/type and the WGL search telemetry (states explored,
-  chunk count, dedup-table load) from metrics.json.
+  chunk count, dedup-table load) from metrics.json,
+* the streaming monitor's telemetry (ops consumed, chunk checks,
+  detection latency) plus its violation instant from the trace.
 
 Usage::
 
@@ -105,6 +107,34 @@ def summarize(store_dir):
             lines.append("\n-- WGL search telemetry --")
             for k, v in wgl.items():
                 lines.append(f"{v!s:>12}  {k}")
+
+        mon = {k: v for k, v in sorted(counters.items())
+               if k.startswith("monitor.")}
+        mon.update({k: v for k, v in
+                    sorted(metrics.get("gauges", {}).items())
+                    if k.startswith("monitor.")})
+        mh = metrics.get("histograms", {}).get("monitor.check_s")
+        if mon or mh:
+            lines.append("\n-- streaming monitor --")
+            for k, v in mon.items():
+                lines.append(f"{v!s:>12}  {k}")
+            if mh and mh.get("count"):
+                lines.append(
+                    f"check wall: mean "
+                    f"{mh['sum'] / mh['count'] * 1e3:.1f} ms   "
+                    f"max {mh['max'] * 1e3:.1f} ms over {mh['count']} "
+                    "check(s)")
+
+    # the monitor's violation instant, if the run recorded one
+    violations = [e for e in events
+                  if e.get("ph") == "i"
+                  and e.get("name") == "monitor.violation"]
+    for e in violations:
+        args = e.get("args") or {}
+        lines.append(
+            f"\n!! monitor violation at history index "
+            f"{args.get('detected_at_index')} "
+            f"(detection latency {args.get('detection_latency_s')}s)")
 
     if len(lines) == 1:
         lines.append("(no trace.jsonl / metrics.json found)")

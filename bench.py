@@ -17,6 +17,10 @@ Rungs (BASELINE.md north-star table):
      (the stretch goal: decided on device where the CPU oracle gives up)
   6. linear engine home turf: 50k-op 2-process crash-free history where
      the CPU event sweep beats the device search (the racer is real)
+  7. streaming-monitor detection latency on an injected violation
+  8. fleet compile-ledger reuse: the same 2x2 matrix run twice in two
+     SEPARATE scheduler processes; the warm process must report
+     persistent-ledger hits > 0, with cold-vs-warm wall clock recorded
 
 The baseline is the sequential CPU WGL oracle (our knossos stand-in,
 checker/wgl.py) with a 60 s / config-capped budget per history.
@@ -176,6 +180,62 @@ def _monitor_rung(n_ops=512, violate_at=256, chunk=64):
         }
     except Exception as exc:  # noqa: BLE001 - numbers, not crashes
         return {"error": repr(exc)}
+
+
+def _fleet_reuse_rung(time_limit_s=3, budget_s=600):
+    """Cross-PROCESS compile reuse (jepsen_tpu.fleet.ledger): run the
+    SAME 2x2 register matrix twice in two separate scheduler
+    processes sharing one store, and report
+
+      cold / warm           per-process wall clock, exit code, and the
+                            campaign report's compile-cache delta
+      cross_process_reuse   True iff the second process reported
+                            ledger hits > 0 (shapes the first process
+                            compiled counted as hits, not re-misses)
+
+    The subprocesses are pinned to CPU: the bench process holds the
+    accelerator, and the ledger's claim is platform-independent.
+    Self-contained and never fatal: a regression must show up as
+    numbers (or an error field), not break the throughput bench."""
+    import os
+    import subprocess
+    import tempfile
+    try:
+        # NB not __file__.rsplit("/", 1): invoked as `python bench.py`
+        # __file__ is relative and has no slash to split on, and the
+        # subprocess (unlike this process) can't lean on cwd
+        repo = os.path.dirname(os.path.abspath(__file__))
+        workdir = tempfile.mkdtemp(prefix="jepsen-fleet-reuse-")
+        env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
+        out = {"matrix": "workload=register x seeds=2",
+               "time_limit_s": time_limit_s}
+        for phase in ("cold", "warm"):
+            t0 = time.monotonic()
+            p = subprocess.run(
+                [sys.executable, "-m", "jepsen_tpu", "campaign",
+                 "--no-ssh", "--time-limit", str(time_limit_s),
+                 "--axis", "workload=register", "--seeds", "2",
+                 "--parallel", "2", "--campaign-id", f"reuse-{phase}"],
+                cwd=workdir, capture_output=True, text=True,
+                timeout=budget_s, env=env)
+            wall = round(time.monotonic() - t0, 1)
+            rep_path = os.path.join(workdir, "store", "campaigns",
+                                    f"reuse-{phase}", "report.json")
+            with open(rep_path) as f:
+                rep = json.load(f)
+            cc = rep.get("compile_cache") or {}
+            out[phase] = {"wall_s": wall, "exit": p.returncode,
+                          "hits": cc.get("hits"),
+                          "misses": cc.get("misses"),
+                          "ledger": cc.get("ledger")}
+        out["cross_process_reuse"] = bool(
+            (out["warm"].get("hits") or 0) > 0)
+        out["warm_speedup"] = round(
+            out["cold"]["wall_s"] / out["warm"]["wall_s"], 2) \
+            if out["warm"]["wall_s"] else None
+        return out
+    except Exception as exc:  # noqa: BLE001 - numbers, not crashes
+        return {"error": repr(exc)[:300]}
 
 
 def _error_headline(msg):
@@ -649,6 +709,11 @@ def _bench_body(_obs_reg):
     # after a violating op lands does the monitor's latch flip. Runs
     # after the timed device rungs (its chunk checks share the chip)
     rungs["7-monitor-detection"] = _monitor_rung()
+
+    # fleet rung: cold-vs-warm wall clock of the same matrix in two
+    # SEPARATE scheduler processes; warm must report ledger hits > 0
+    # (runs on CPU in subprocesses -- see the rung's docstring)
+    rungs["8-fleet-reuse"] = _fleet_reuse_rung()
 
     # CPU oracles race in parallel subprocesses AFTER all device
     # measurements (their CPU load would pollute the device numbers);

@@ -50,6 +50,9 @@ def main(argv=None):
     subcommands.update(cli.campaign_cmd({
         "test-fn": demo.demo_test,
         "opt-spec": _add_demo_opts,
+        # fleet workers rebuild cells in their own process from this
+        # importable ref (must match test-fn)
+        "builder": "jepsen_tpu.demo:demo_test",
     }))
     subcommands.update(cli.serve_cmd())
     cli.run(subcommands, argv)

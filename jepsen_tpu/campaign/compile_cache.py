@@ -26,6 +26,12 @@ share one shape. Padding rows are inert by construction (they can
 never become search candidates), so a larger floor trades a little
 per-iteration device work for one compile across the whole sweep.
 
+The in-memory ledger dies with the process; attach the disk-backed
+half (`jepsen_tpu.fleet.ledger`, ``store/compile_ledger/``) via
+``set_ledger`` and first sightings persist across restarts AND across
+concurrent campaign processes: ``note`` re-reads sibling processes'
+appends before declaring a miss.
+
 Deliberately dependency-light (obs only): checker.jax_wgl imports this
 lazily from inside the search entry points, and nothing here may drag
 the scheduler -> core -> checker import chain back in.
@@ -39,7 +45,7 @@ import threading
 from .. import obs
 
 __all__ = ["bucket", "note", "stats", "reset", "n_floor", "set_n_floor",
-           "bucket_floor", "DEFAULT_N_FLOOR"]
+           "bucket_floor", "DEFAULT_N_FLOOR", "set_ledger", "get_ledger"]
 
 #: default minimum op-count bucket (matches jax_wgl's historical 64)
 DEFAULT_N_FLOOR = 64
@@ -49,6 +55,7 @@ _seen: set = set()
 _hits: dict = {}          # engine -> int
 _misses: dict = {}        # engine -> int
 _n_floor = DEFAULT_N_FLOOR
+_ledger = None            # fleet.ledger.Ledger when persistence is on
 
 
 def bucket(x, lo=1):
@@ -83,14 +90,64 @@ def bucket_floor(n):
         set_n_floor(prev)
 
 
+def set_ledger(ledger):
+    """Attach (or, with None, detach) the persistent disk ledger
+    (fleet.ledger.Ledger). On attach, disk-known shapes fold into the
+    seen set so they count as hits from the first sighting on."""
+    global _ledger
+    keys = ledger.refresh() if ledger is not None else ()
+    with _lock:
+        _ledger = ledger
+        _seen.update(keys)
+
+
+def get_ledger():
+    with _lock:
+        return _ledger
+
+
+def _canon(engine, key):
+    """Canonical hashable key. With a ledger attached, keys must
+    compare equal across a JSON round trip (live tuple vs re-read
+    line), so they are normalized through it; without one, the raw
+    tuple is cheaper and equivalent."""
+    led = get_ledger()
+    if led is None:
+        return (str(engine), tuple(key))
+    from ..fleet.ledger import canon_key
+    return canon_key(engine, key)
+
+
+def _refresh_from(led):
+    """Fold the ledger's latest on-disk keys into the seen set."""
+    try:
+        fresh = led.refresh()
+    except Exception:  # noqa: BLE001 - ledger is bookkeeping only
+        return
+    with _lock:
+        _seen.update(fresh)
+
+
 def note(engine, key):
     """Record one search's compile plan. ``key`` must contain every
     value that feeds the engine's jit cache key (spec name + plan
     sizes). Returns True on a hit (a shape-identical search already
     ran in this process, so the jit cache served the compile), False
     on a miss. Mirrored to the bound obs registry as
-    ``campaign.compile_cache.{hits,misses}{engine=...}``."""
-    k = (str(engine), tuple(key))
+    ``campaign.compile_cache.{hits,misses}{engine=...}``.
+
+    With a persistent ledger attached, a shape any OTHER process has
+    recorded also counts as a hit (the disk file is re-read before a
+    miss is declared), and fresh misses are appended for siblings and
+    successors."""
+    k = _canon(engine, key)
+    led = get_ledger()
+    with _lock:
+        hit = k in _seen
+    if not hit and led is not None:
+        # not seen locally: a sibling process may have compiled this
+        # shape since our last read -- refresh before declaring a miss
+        _refresh_from(led)
     with _lock:
         hit = k in _seen
         if hit:
@@ -98,6 +155,8 @@ def note(engine, key):
         else:
             _seen.add(k)
             _misses[engine] = _misses.get(engine, 0) + 1
+    if not hit and led is not None:
+        led.record(engine, key)
     obs.inc("campaign.compile_cache.hits" if hit
             else "campaign.compile_cache.misses", engine=str(engine))
     return hit
@@ -128,10 +187,13 @@ def delta(before):
 
 
 def reset():
-    """Forget everything (tests). Does NOT touch jax's jit cache --
-    after a reset the first sighting of a still-compiled shape counts
-    as a miss even though the compile is skipped."""
+    """Forget everything and detach any persistent ledger (tests).
+    Does NOT touch jax's jit cache -- after a reset the first sighting
+    of a still-compiled shape counts as a miss even though the compile
+    is skipped."""
+    global _ledger
     with _lock:
         _seen.clear()
         _hits.clear()
         _misses.clear()
+        _ledger = None

@@ -87,6 +87,21 @@ class CampaignJournal:
         line without a newline; appending straight onto it would merge
         this record into the fragment and corrupt both, so the torn
         tail is terminated first (the read path skips the fragment)."""
+        assert not record.get("event"), \
+            "outcome records must not carry an 'event' key"
+        self._append_line(record)
+
+    def append_event(self, record):
+        """Append one bookkeeping event (fleet lease grant/failure):
+        same crash-only discipline as outcomes, but the record carries
+        an ``"event"`` key so the latest-per-cell outcome fold
+        (store.latest_campaign_records) skips it -- the journal stays
+        the single source of truth for BOTH who holds a cell and what
+        finally happened to it."""
+        assert record.get("event"), "event records need an 'event' key"
+        self._append_line(record)
+
+    def _append_line(self, record):
         line = json.dumps(record, cls=store._Encoder)
         with self._lock:
             torn = False
@@ -107,9 +122,14 @@ class CampaignJournal:
                     pass
 
     def records(self):
-        """All journal records in append order; a torn final line
-        (killed mid-append) is dropped rather than fatal."""
+        """All journal records in append order (outcomes AND events); a
+        torn final line (killed mid-append) is dropped rather than
+        fatal."""
         return store.load_campaign_records(self.campaign_id)
+
+    def events(self):
+        """Bookkeeping event records only (store's shared filter)."""
+        return store.campaign_events(self.campaign_id)
 
     def latest(self):
         """One record per cell, latest wins (store's shared fold)."""

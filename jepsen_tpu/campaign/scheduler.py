@@ -125,13 +125,25 @@ def _outcome_of(test, latch):
 
 
 def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
-              resume=False, latch=None, run_fn=None):
+              resume=False, latch=None, run_fn=None, ledger=True,
+              backends=None):
     """Run a campaign; returns the aggregated report dict (also
     persisted as report.json in the campaign directory).
 
     ``resume=True`` requires an existing campaign: pass its id, or
     leave ``campaign_id`` None to pick the most recently touched one.
-    """
+
+    ``ledger=True`` (default) attaches the disk-persistent compile
+    ledger (fleet.ledger, ``store/compile_ledger/``) so compile-cache
+    hits survive restarts and are shared across concurrent campaign
+    processes; the campaign's hit/miss delta is appended to the ledger
+    at finalize and the aggregate appears in the report.
+
+    ``backends`` (fleet.backends.Failover or a tier list) enables
+    per-cell backend failover: before each cell runs, the healthiest
+    tier is chosen and applied (a dead accelerator degrades the cell
+    to the CPU oracle instead of crashing it); the chosen tier is
+    journaled on the cell record."""
     cells = list(cells)
     ids = [c["id"] for c in cells]
     if len(set(ids)) != len(ids):
@@ -180,6 +192,18 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
     latch = latch or robust.AbortLatch()
     sem = threading.BoundedSemaphore(max(1, int(device_slots)))
     tr, reg = Tracer(), Registry()
+    led = None
+    if ledger:
+        try:
+            from ..fleet import ledger as fledger
+            led = fledger.attach()
+        except Exception:  # noqa: BLE001 - persistence is optional
+            logger.warning("couldn't attach the persistent compile "
+                           "ledger; in-memory counting only",
+                           exc_info=True)
+    if backends is not None:
+        from ..fleet import backends as fbackends
+        backends = fbackends.as_failover(backends)
     cc_before = compile_cache.stats()
     pending = [c for c in cells if c["id"] not in done]
     reg.set_gauge("campaign.cells_total", len(cells))
@@ -211,6 +235,13 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
                     {"id": campaign_id, "cell": cid,
                      "params": cell.get("params") or {}})
                 test["abort"] = latch
+                if backends is not None:
+                    # failover tiering: a down accelerator degrades
+                    # this cell to a slower tier instead of crashing it
+                    tier = backends.choose()
+                    backends.apply(test, tier)
+                    rec["backend"] = tier
+                    reg.inc("fleet.backend_cells", tier=str(tier))
                 if test.get("checker") is not None:
                     test["checker"] = _DeviceSlotChecker(
                         test["checker"], sem, reg)
@@ -285,6 +316,16 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
     cc = compile_cache.delta(cc_before)
     reg.set_gauge("campaign.compile_cache.hits", cc["hits"])
     reg.set_gauge("campaign.compile_cache.misses", cc["misses"])
+    if led is not None:
+        # persist this campaign's reuse delta, then surface the
+        # cross-process aggregate: hits observed across SEPARATE
+        # scheduler processes are the ledger's whole point
+        led.note_stats(cc["hits"], cc["misses"])
+        try:
+            cc = dict(cc, ledger=led.stats())
+        except Exception:  # noqa: BLE001 - bookkeeping only
+            logger.warning("couldn't aggregate compile-ledger stats",
+                           exc_info=True)
     aborted = latch.is_set()
     # the journal is the source of truth, latest record per cell: on a
     # hard abort, pool threads may have journaled cells whose futures

@@ -348,7 +348,40 @@ def _add_campaign_opts(parser, axes=False):
                              "outcome is already journaled; without "
                              "--campaign-id, the most recent campaign "
                              "is resumed.")
+    parser.add_argument("--no-ledger", action="store_true",
+                        help="Don't persist the compile-reuse ledger "
+                             "to store/compile_ledger/ (it is "
+                             "persisted, and shared across campaign "
+                             "processes, by default).")
+    parser.add_argument("--backends", default=None,
+                        metavar="TIER1,TIER2",
+                        help="Backend failover ladder consulted per "
+                             "cell (tiers: tpu, gpu, cpu; e.g. "
+                             "tpu,gpu,cpu). A down accelerator "
+                             "degrades cells to the next tier instead "
+                             "of crashing them.")
     if axes:
+        parser.add_argument("--workers", default=None,
+                            metavar="HOST1,HOST2",
+                            help="Fleet mode: lease cells to these "
+                                 "worker hosts over the SSH control "
+                                 "plane ('local' = loopback worker "
+                                 "processes; name=host gives explicit "
+                                 "worker ids).")
+        parser.add_argument("--lease", type=float, default=None,
+                            metavar="SECONDS",
+                            help="Fleet lease TTL: a cell exec running "
+                                 "past this is presumed dead and its "
+                                 "cell is stolen by another worker "
+                                 "(default 600).")
+        parser.add_argument("--serve", action="store_true",
+                            help="Serve the web UI + submission API "
+                                 "(POST /api/check, /api/campaigns) "
+                                 "alongside the campaign, so its "
+                                 "status is pollable while it runs.")
+        parser.add_argument("--serve-port", type=int, default=8080,
+                            metavar="PORT",
+                            help="Port for --serve (default 8080).")
         parser.add_argument("--axis", action="append", default=[],
                             metavar="NAME=V1,V2,...",
                             help="A sweep axis: option NAME takes each "
@@ -395,7 +428,9 @@ def test_all_cmd(opts):
                     cells, parallel=options.get("parallel", 1),
                     device_slots=options.get("device-slots", 1),
                     campaign_id=options.get("campaign-id"),
-                    resume=bool(options.get("resume")))
+                    resume=bool(options.get("resume")),
+                    ledger=not options.get("no-ledger"),
+                    backends=options.get("backends") or None)
             except campaign.CampaignError as e:
                 raise CliError(str(e)) from e
             print(campaign.report.render_text(report))
@@ -436,6 +471,32 @@ def parse_axes(specs, seeds=None):
     return axes
 
 
+#: option keys that are coordinator-local wiring, never shipped to a
+#: fleet worker's cell spec
+_FLEET_LOCAL_OPTS = {
+    "argv", "workers", "lease", "serve", "serve-port", "no-ledger",
+    "backends", "axis", "seeds", "parallel", "device-slots",
+    "campaign-id", "resume", "lint?",
+}
+
+
+def _jsonable_options(options):
+    """The JSON-serializable subset of the parsed options: what a
+    fleet worker's cell spec carries so the remote build sees the same
+    base options the coordinator would have used locally."""
+    import json as _json
+    out = {}
+    for k, v in options.items():
+        if k in _FLEET_LOCAL_OPTS:
+            continue
+        try:
+            _json.dumps(v)
+        except (TypeError, ValueError):
+            continue
+        out[k] = v
+    return out
+
+
 def campaign_cmd(opts):
     """Subcommand ``campaign``: expand a sweep matrix over the suite's
     test-fn and run it as a parallel, resumable campaign. opts:
@@ -466,6 +527,29 @@ def campaign_cmd(opts):
         matrix = {"axes": axes}
         cells_plan = campaign.plan.expand(matrix)
         diags = campaign.plan.lint(matrix)
+        # fleet-config preflight (PL014) rides along whenever any
+        # fleet-facing knob is set; run_fleet re-checks, but --lint
+        # must surface the findings without contacting a host
+        workers = None
+        if options.get("workers"):
+            from . import fleet
+            workers = fleet.parse_workers(options["workers"],
+                                          ssh=options.get("ssh"))
+        fleet_cfg = {
+            "lease-s": options.get("lease"),
+            "serve?": bool(options.get("serve")),
+            "device-slots": options.get("device-slots"),
+            "backends": [t.strip() for t in
+                         str(options["backends"]).split(",")
+                         if t.strip()]
+            if options.get("backends") else None,
+            "time-limit": options.get("time-limit"),
+        }
+        if workers is not None:
+            fleet_cfg["workers"] = [w.id for w in workers]
+        if workers is not None or options.get("serve") \
+                or options.get("backends"):
+            diags += analysis.planlint.lint_fleet(fleet_cfg)
         if options.get("lint?"):
             print(analysis.render_text(diags, title="campaign lint:"))
             for c in cells_plan:
@@ -473,7 +557,31 @@ def campaign_cmd(opts):
             sys.exit(1 if analysis.errors(diags) else 0)
         if analysis.errors(diags):
             raise CliError(analysis.render_text(
-                diags, title="campaign matrix invalid:"))
+                analysis.errors(diags),
+                title="campaign matrix invalid:"))
+        if options.get("serve"):
+            from . import web
+            web.serve({"ip": "0.0.0.0",
+                       "port": options.get("serve-port", 8080)})
+        if workers is not None:
+            from . import fleet
+            try:
+                report = fleet.run_fleet(
+                    cells_plan, workers,
+                    campaign_id=options.get("campaign-id"),
+                    resume=bool(options.get("resume")),
+                    lease_s=options.get("lease")
+                    or fleet.dispatch.DEFAULT_LEASE_S,
+                    builder=opts.get("builder"),
+                    base_options=_jsonable_options(options),
+                    ledger=not options.get("no-ledger"),
+                    backends=options.get("backends") or None,
+                    serve=bool(options.get("serve")),
+                    device_slots=options.get("device-slots", 1))
+            except fleet.FleetError as e:
+                raise CliError(str(e)) from e
+            print(campaign.report.render_text(report))
+            sys.exit(campaign_exit_code(report))
 
         # seed + build are one atomic step: scheduler pool threads
         # build cells concurrently, and the global RNG must not be
@@ -504,7 +612,9 @@ def campaign_cmd(opts):
                 cells, parallel=options.get("parallel", 1),
                 device_slots=options.get("device-slots", 1),
                 campaign_id=options.get("campaign-id"),
-                resume=bool(options.get("resume")))
+                resume=bool(options.get("resume")),
+                ledger=not options.get("no-ledger"),
+                backends=options.get("backends") or None)
         except campaign.CampaignError as e:
             raise CliError(str(e)) from e
         print(campaign.report.render_text(report))
@@ -532,8 +642,16 @@ def serve_cmd():
                    "port": options.get("port", 8080)})
         print(f"Listening on http://{options.get('host')}:"
               f"{options.get('port')}/")
-        while True:
-            time.sleep(1)
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            # honor the service's shared AbortLatch: campaigns
+            # submitted over POST /api/campaigns abort gracefully and
+            # stay resumable instead of dying with the server
+            from .fleet import service
+            print("shutting down: aborting submitted campaigns...")
+            service.shutdown()
 
     return {"serve": {"opt-spec": add_opts, "opt-fn": lambda o: o,
                       "standalone": True, "run": run_serve,

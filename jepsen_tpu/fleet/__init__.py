@@ -1,0 +1,55 @@
+"""The fleet layer: campaigns as a multi-host, restart-surviving,
+externally-callable checking service.
+
+The reference Jepsen is itself a distributed orchestrator -- a control
+node driving N workers over SSH -- and the NP-hard core check ("On the
+complexity of Linearizability", arxiv 1410.5000) makes fleet-level
+parallelism over *independent* cells the honest scaling axis beyond
+per-device kernels: the partition-compatibility argument
+P-compositionality (arxiv 1504.00204) makes for keys applies verbatim
+to campaign cells. Four pillars:
+
+* **ledger** -- the campaign compile-reuse ledger
+  (``campaign/compile_cache.py``) made disk-persistent under
+  ``store/compile_ledger/``: atomic fcntl-locked appends, torn-tail
+  tolerant reads, so compile-cache knowledge survives process restarts
+  and is shared across concurrent campaign processes.
+* **dispatch + worker** -- remote-worker campaigns: the dispatcher
+  leases cells to N hosts over the *existing* ``control/remotes.py``
+  SSH plane (our own L0 control plane, RetryPolicy-backed probes
+  included), lease records append to the campaign journal as the
+  single source of truth, and an expired or dead worker's cell is
+  re-leased to another host (work stealing).
+* **service** -- ``web.py`` grown from a viewer into a submission API:
+  ``POST /api/check`` (history JSON -> verdict via histlint + the
+  monitor's engine dispatch) and ``POST /api/campaigns`` (sweep matrix
+  -> pollable campaign), with request-size limits, JSON errors, and a
+  shared AbortLatch honored on shutdown.
+* **backends** -- per-cell backend failover tiering (tpu -> gpu ->
+  cpu) chosen by a cached health probe, so a down accelerator degrades
+  a campaign to slower verdicts instead of 0.0.
+
+Submodules that pull in the heavy harness chain load lazily;
+``ledger`` stays dependency-light (store + fcntl only) because
+``campaign.compile_cache`` imports it from inside the note path.
+"""
+
+from __future__ import annotations
+
+_LAZY = ("ledger", "worker", "dispatch", "service", "backends")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name in ("run_fleet", "FleetError", "parse_workers"):
+        from . import dispatch
+        return getattr(dispatch, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["ledger", "worker", "dispatch", "service", "backends",
+           "run_fleet", "FleetError", "parse_workers"]

@@ -1,0 +1,190 @@
+"""Backend failover tiering: a down accelerator degrades a campaign
+to slower verdicts instead of 0.0.
+
+The r05 bench run scored 0.0 for one reason only: the remote-TPU
+tunnel was down and nothing fell back. This module is the scheduler's
+answer -- an ordered ladder of backend *tiers*::
+
+    tpu -> gpu -> cpu
+
+each with a health probe (jax backend init in a KILLABLE subprocess,
+the bench's ``_device_preflight`` lesson: a dead tunnel HANGS rather
+than errors) and a cached verdict, so per-cell tier choice costs a
+dict lookup, not a probe. The last tier (``cpu``) is the
+unconditional floor: jax's CPU backend initializes everywhere, and
+the CPU engines (linear / sequential wgl) still produce verdicts --
+slower, budget-capped, but never 0.0.
+
+Two application points:
+
+* **in-process** (campaign scheduler): jax's platform is frozen after
+  backend init, so ``apply`` degrades the CHECKER instead -- every
+  ``Linearizable`` gate in the cell's checker tree is re-pointed at
+  the tier's algorithm (cpu tier -> the ``linear`` event-sweep, the
+  monitor's own CPU-only choice).
+* **cross-process** (fleet dispatch / workers): the worker process is
+  fresh, so the dispatcher additionally exports ``tier_env`` --
+  ``JAX_PLATFORMS=<tier>`` -- and the worker's jax really does come up
+  on the degraded platform.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["TIERS", "DEFAULT_LADDER", "tier_env", "probe", "Failover",
+           "as_failover", "apply"]
+
+#: known tiers: JAX_PLATFORMS value + the in-process checker algorithm
+#: the tier degrades to (None = leave the checker's own choice alone)
+TIERS = {
+    "tpu": {"platforms": "tpu", "algorithm": None},
+    "gpu": {"platforms": "cuda", "algorithm": None},
+    "cpu": {"platforms": "cpu", "algorithm": "linear"},
+}
+
+#: the default failover ladder, best tier first
+DEFAULT_LADDER = ("tpu", "gpu", "cpu")
+
+#: how long one probe verdict stays fresh
+PROBE_TTL_S = 300.0
+
+PROBE_TIMEOUT_S = 60.0
+
+
+def tier_env(tier):
+    """The env a fresh worker process needs to come up on ``tier``."""
+    return {"JAX_PLATFORMS": TIERS[str(tier)]["platforms"]}
+
+
+def probe(tier, timeout_s=PROBE_TIMEOUT_S):
+    """Is ``tier``'s jax backend reachable? Probed in a killable
+    subprocess -- a dead TPU tunnel hangs backend init forever (the
+    r05 failure mode), and a hang must read as "down", not block the
+    scheduler. Returns None when healthy, an error string otherwise."""
+    env = dict(os.environ, **tier_env(tier))
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()"],
+            timeout=timeout_s, capture_output=True, text=True, env=env)
+    except subprocess.TimeoutExpired:
+        return f"backend init hung >{timeout_s:g}s"
+    except OSError as e:  # pragma: no cover - no python?!
+        return repr(e)
+    if p.returncode == 0:
+        return None
+    return (p.stderr.strip()[-300:] or "backend init failed")
+
+
+class Failover:
+    """The per-campaign tier chooser: probe verdicts cached with a TTL
+    so the scheduler consults it per CELL for the cost of a lookup,
+    while a tier that comes back up is noticed within ``ttl_s``."""
+
+    def __init__(self, ladder=DEFAULT_LADDER, probe_fn=probe,
+                 ttl_s=PROBE_TTL_S, probe_timeout_s=PROBE_TIMEOUT_S):
+        ladder = [str(t) for t in ladder]
+        unknown = [t for t in ladder if t not in TIERS]
+        if unknown:
+            raise ValueError(f"unknown backend tier(s) {unknown}; "
+                             f"known: {list(TIERS)}")
+        if not ladder:
+            raise ValueError("failover ladder needs at least one tier")
+        self.ladder = ladder
+        self.probe_fn = probe_fn
+        self.ttl_s = float(ttl_s)
+        self.probe_timeout_s = probe_timeout_s
+        self._lock = threading.Lock()
+        self._probe_lock = threading.Lock()
+        self._cache = {}     # tier -> (monotonic stamp, error|None)
+
+    def _cached(self, tier):
+        with self._lock:
+            hit = self._cache.get(tier)
+            if hit is not None \
+                    and time.monotonic() - hit[0] < self.ttl_s:
+                return hit
+        return None
+
+    def health(self, tier):
+        """Cached probe verdict for one tier (None = healthy). Probes
+        are serialized and double-checked: N worker threads missing
+        the cache together must launch ONE probe subprocess, not N
+        60-second interpreter boots."""
+        hit = self._cached(tier)
+        if hit is not None:
+            return hit[1]
+        with self._probe_lock:
+            hit = self._cached(tier)   # a peer probed while we waited
+            if hit is not None:
+                return hit[1]
+            err = self.probe_fn(tier, timeout_s=self.probe_timeout_s)
+            if err is not None:
+                logger.warning("backend tier %r unhealthy: %s", tier,
+                               err)
+            with self._lock:
+                self._cache[tier] = (time.monotonic(), err)
+            return err
+
+    def choose(self):
+        """The best healthy tier; the ladder's LAST tier is the
+        unconditional floor (degraded verdicts beat none)."""
+        for tier in self.ladder[:-1]:
+            if self.health(tier) is None:
+                return tier
+        return self.ladder[-1]
+
+    def apply(self, test, tier):
+        """In-process degrade: re-point the cell's checker at the
+        tier's algorithm (see module docstring)."""
+        apply(test, tier)
+
+
+def apply(test, tier):
+    """Rewrite every Linearizable gate in ``test``'s checker tree to
+    the tier's algorithm and stamp ``test["backend"]``. A tier whose
+    algorithm is None (healthy accelerator) leaves the checker alone."""
+    test["backend"] = str(tier)
+    algorithm = TIERS[str(tier)]["algorithm"]
+    if algorithm is None:
+        return test
+    from ..checker.checkers import Linearizable
+    seen = set()
+
+    def walk(c):
+        if c is None or id(c) in seen:
+            return
+        seen.add(id(c))
+        if isinstance(c, Linearizable):
+            c.algorithm = algorithm
+            return
+        for attr in ("inner", "checker"):
+            walk(getattr(c, attr, None))
+        cmap = getattr(c, "checker_map", None)
+        if isinstance(cmap, dict):
+            for child in cmap.values():
+                walk(child)
+
+    walk(test.get("checker"))
+    return test
+
+
+def as_failover(x):
+    """Coerce run_cells/run_fleet's ``backends`` argument: an existing
+    Failover passes through; a tier list (or comma string) becomes the
+    ladder; True means the default ladder."""
+    if isinstance(x, Failover):
+        return x
+    if x is True:
+        return Failover()
+    if isinstance(x, str):
+        x = [t.strip() for t in x.split(",") if t.strip()]
+    return Failover(ladder=list(x))

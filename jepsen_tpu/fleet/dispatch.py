@@ -1,0 +1,497 @@
+"""The fleet dispatcher: lease campaign cells to worker hosts over the
+L0 control plane, steal work from the dead.
+
+Execution model (the reference Jepsen's own shape -- one control node
+driving workers over SSH -- turned on ourselves):
+
+* **Workers.** Each worker is a host reached through
+  ``control/remotes.py`` -- ``SSHRemote`` for real hosts, or
+  ``LocalRemote`` for the loopback topology (N worker *processes* on
+  one machine; how the tests and the CI smoke run). Connection
+  liveness is probed through the RetryPolicy-backed ``RetryRemote``
+  (the L0 plane's own flake armor); the cell exec itself uses the raw
+  transport -- a cell run is not idempotent at the transport layer,
+  and re-running is the LEASE machinery's decision, not the retry
+  loop's.
+* **Leases.** The dispatcher pops a pending cell, journals a
+  ``lease`` event, and execs ``python -m jepsen_tpu.fleet.worker``
+  with the cell spec on stdin and a transport timeout of the lease
+  TTL. A worker that returns a result line completes the lease; one
+  that dies (kill -9 -> nonzero exit, no result) or times out forfeits
+  it, and the cell goes back on the queue for ANY worker to re-lease
+  (work stealing), up to ``max_leases`` attempts. A
+  ``robust.LeaseWatchdog`` backstops wedged transports.
+* **Journal = truth.** Lease grants, expiries, and failures append to
+  the campaign journal as event records; outcomes append exactly once
+  per cell (a terminal-guard drops a stolen cell's late duplicate), so
+  ``cells.jsonl`` alone reconstructs who ran what, who died, and what
+  the verdict was -- and ``--resume`` works unchanged.
+* **Abort.** One ``robust.AbortLatch`` (SIGINT/SIGTERM) stops new
+  leases; in-flight execs drain to their transport timeout and the
+  journal is left resumable.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import traceback
+
+from .. import robust, store
+from ..control import remotes
+from ..obs import Registry, Tracer
+from ..campaign import compile_cache
+from ..campaign import report as creport
+from ..campaign.journal import CampaignJournal
+from ..campaign.scheduler import CampaignError, new_campaign_id
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["FleetError", "Worker", "parse_workers", "run_fleet",
+           "DEFAULT_LEASE_S", "MAX_LEASES"]
+
+#: default lease TTL: how long one cell exec may run before its worker
+#: is presumed dead and the cell is stolen
+DEFAULT_LEASE_S = 600.0
+
+#: how many leases a cell may burn before it journals as crashed
+MAX_LEASES = 3
+
+#: consecutive transport-layer failures before a worker is retired
+WORKER_STRIKES = 3
+
+
+class FleetError(CampaignError):
+    """Fleet-level wiring failure (no workers, PL014 errors)."""
+
+
+class Worker:
+    """One worker host: id + the conn spec its remotes connect with."""
+
+    def __init__(self, wid, host, kind="ssh", conn_spec=None):
+        self.id = str(wid)
+        self.host = str(host)
+        self.kind = kind
+        self.conn_spec = dict(conn_spec or {}, host=self.host)
+
+    def __repr__(self):
+        return f"Worker({self.id!r}, {self.host!r}, {self.kind})"
+
+    def _base_remote(self):
+        if self.kind == "local":
+            return remotes.LocalRemote()
+        return remotes.SSHRemote()
+
+    def connect(self):
+        """The raw (non-retrying) transport for cell execs."""
+        return self._base_remote().connect(self.conn_spec)
+
+    def probe(self, timeout_s=30):
+        """Liveness probe through the RetryPolicy-backed transport
+        (dogfooding control's flake armor where retries ARE safe).
+        Returns None when healthy, an error string otherwise."""
+        try:
+            conn = remotes.RetryRemote(
+                self._base_remote()).connect(self.conn_spec)
+            res = conn.execute({"timeout": timeout_s}, {"cmd": "true"})
+            if res.get("exit") != 0:
+                return (f"probe exit {res.get('exit')}: "
+                        f"{(res.get('err') or '')[:200]}")
+            return None
+        except Exception as exc:  # noqa: BLE001 - probe must not raise
+            return repr(exc)
+
+
+LOCAL_HOSTS = ("local", "localhost", "127.0.0.1")
+
+
+def parse_workers(spec, ssh=None):
+    """``"host1,host2"`` (or a list) -> [Worker]. ``name=host`` gives
+    an explicit worker id; repeated bare hosts auto-suffix (``local``,
+    ``local#2``) so N loopback worker processes coexist. ``local`` /
+    ``localhost`` use the LocalRemote transport; anything else is an
+    SSH host resolved with the suite's ssh options."""
+    if isinstance(spec, str):
+        entries = [e.strip() for e in spec.split(",") if e.strip()]
+    else:
+        entries = [str(e).strip() for e in (spec or []) if str(e).strip()]
+    ssh = ssh or {}
+    conn = {k: ssh.get(k) for k in ("port", "username",
+                                    "private-key-path",
+                                    "strict-host-key-checking")
+            if ssh.get(k) is not None}
+    out, seen = [], {}
+    for entry in entries:
+        wid, eq, host = entry.partition("=")
+        if not eq:
+            wid, host = entry, entry
+        seen[wid] = seen.get(wid, 0) + 1
+        if seen[wid] > 1 and not eq:
+            wid = f"{wid}#{seen[wid]}"
+        kind = "local" if host in LOCAL_HOSTS else "ssh"
+        out.append(Worker(wid, host, kind=kind, conn_spec=conn))
+    return out
+
+
+def _repo_root():
+    """The directory ``python -m jepsen_tpu...`` must run from."""
+    import jepsen_tpu
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(jepsen_tpu.__file__)))
+
+
+def run_fleet(cells, workers, *, campaign_id=None, resume=False,
+              lease_s=DEFAULT_LEASE_S, max_leases=MAX_LEASES,
+              builder=None, base_options=None, latch=None, ledger=True,
+              backends=None, python=None, cwd=None, serve=False,
+              device_slots=1, probe=True, env=None):
+    """Run a campaign across worker hosts; returns the report dict
+    (persisted as report.json, same shape as scheduler.run_cells).
+
+    ``cells`` are plan-style ``{"id", "group", "params"}`` maps;
+    ``builder`` is the importable ``"pkg.module:fn"`` every worker
+    rebuilds test maps with, fed ``base_options`` overlaid with each
+    cell's params. ``serve``/``device_slots`` participate only in the
+    PL014 preflight (the CLI co-launches the service)."""
+    from ..analysis import planlint, render_text, errors as diag_errors
+
+    workers = [w if isinstance(w, Worker) else Worker(w, w)
+               for w in (workers or [])]
+    cells = list(cells)
+    base_options = dict(base_options or {})
+    diags = planlint.lint_fleet({
+        "workers": [w.id for w in workers],
+        "lease-s": lease_s,
+        "serve?": serve,
+        "device-slots": device_slots,
+        "backends": backends,
+        "time-limit": base_options.get("time-limit"),
+    })
+    if diags:
+        logger.warning("%s", render_text(diags,
+                                         title="fleet preflight:"))
+    if diag_errors(diags):
+        raise FleetError(render_text(diag_errors(diags),
+                                     title="fleet config invalid:"))
+    ids = [c["id"] for c in cells]
+    if len(set(ids)) != len(ids):
+        raise FleetError(f"duplicate cell ids: "
+                         f"{sorted({i for i in ids if ids.count(i) > 1})}")
+
+    if resume and campaign_id is None:
+        campaign_id = store.latest_campaign()
+        if campaign_id is None:
+            raise FleetError("--resume: no campaign found in the store")
+    campaign_id = campaign_id or new_campaign_id()
+    jr = CampaignJournal(campaign_id)
+    prior = jr.load_meta()
+    if resume and prior is None:
+        raise FleetError(f"--resume: campaign {campaign_id!r} was "
+                         "never started")
+    if prior is not None and not resume:
+        raise FleetError(
+            f"campaign {campaign_id!r} already exists: pass --resume "
+            "to continue it, or pick a new --campaign-id")
+    done = jr.completed() if resume else {}
+    jr.write_meta({
+        "status": "running", "mode": "fleet",
+        "created": (prior or {}).get("created") or store.local_time(),
+        "updated": store.local_time(),
+        "cells": ids,
+        "workers": [w.id for w in workers],
+        "lease-s": lease_s,
+        "resumes": ((prior or {}).get("resumes") or 0)
+        + (1 if resume else 0),
+    })
+
+    latch = latch or robust.AbortLatch()
+    tr, reg = Tracer(), Registry()
+    led = None
+    if ledger:
+        try:
+            from . import ledger as fledger
+            led = fledger.attach()
+        except Exception:  # noqa: BLE001 - persistence is optional
+            logger.warning("couldn't attach the persistent compile "
+                           "ledger", exc_info=True)
+    if backends is not None:
+        from . import backends as fbackends
+        backends = fbackends.as_failover(backends)
+    # loopback workers must run the coordinator's interpreter; REMOTE
+    # hosts usually want an explicit python= path instead
+    import sys
+    python = python or (sys.executable
+                        if all(w.kind == "local" for w in workers)
+                        else "python3")
+    cwd = cwd or _repo_root()
+    store_dir = os.path.abspath(store.base_dir)
+
+    cond = threading.Condition()
+    pending = collections.deque(c for c in cells if c["id"] not in done)
+    by_id = {c["id"]: c for c in cells}
+    terminal = set(done)
+    alive = {w.id for w in workers}
+    table = robust.LeaseTable()
+    reg.set_gauge("fleet.cells_total", len(cells))
+    reg.set_gauge("fleet.cells_resumed", len(done))
+    reg.set_gauge("fleet.workers", len(workers))
+
+    def finish(cid, rec):
+        """Terminal-guard append: at most ONE outcome per cell, ever.
+        Caller holds ``cond``."""
+        if cid in terminal:
+            reg.inc("fleet.stale_results")
+            logger.info("dropping stale result for already-terminal "
+                        "cell %s", cid)
+            return False
+        terminal.add(cid)
+        jr.append_cell(rec)
+        reg.inc("fleet.cells", outcome=str(rec.get("outcome")))
+        if rec.get("wall_s") is not None:
+            reg.observe("fleet.cell_s", rec["wall_s"])
+        cond.notify_all()
+        return True
+
+    def requeue_or_fail(cid, worker_id, error):
+        """A lease was forfeited: steal (requeue) or, past the attempt
+        budget, journal the cell crashed. Caller holds ``cond``."""
+        if cid in terminal:
+            return
+        jr.append_event({"event": "lease-failed", "cell": cid,
+                         "worker": worker_id, "error": str(error)[:500],
+                         "t": store.local_time()})
+        if table.attempts(cid) >= max_leases:
+            finish(cid, {"cell": cid,
+                         "group": by_id[cid].get("group") or cid,
+                         "params": by_id[cid].get("params") or {},
+                         "outcome": "crashed",
+                         "error": f"lease budget exhausted "
+                                  f"({max_leases} leases); last: "
+                                  f"{str(error)[:300]}"})
+        elif cid not in [c["id"] for c in pending]:
+            pending.append(by_id[cid])
+            reg.inc("fleet.cells_stolen")
+            cond.notify_all()
+
+    def on_lease_expired(lease):
+        """LeaseWatchdog backstop: the transport wedged past its own
+        timeout; put the cell back up for stealing."""
+        reg.inc("fleet.lease_expired")
+        with cond:
+            jr.append_event({"event": "lease-expired",
+                             "cell": lease.unit,
+                             "worker": lease.holder,
+                             "attempt": lease.attempt,
+                             "t": store.local_time()})
+            requeue_or_fail(lease.unit, lease.holder,
+                            f"lease expired after {lease.ttl_s:.0f}s")
+
+    def next_cell():
+        """Block until a cell is available, all work is terminal, or
+        the latch aborts; returns a cell or None."""
+        with cond:
+            while True:
+                if latch.is_set():
+                    return None
+                if pending:
+                    return pending.popleft()
+                if len(terminal) >= len(cells) or not alive:
+                    return None
+                cond.wait(timeout=0.5)
+
+    def cell_spec(cell, worker):
+        spec = {"campaign": campaign_id, "cell": cell["id"],
+                "group": cell.get("group") or cell["id"],
+                "params": cell.get("params") or {},
+                "options": base_options,
+                "builder": builder or "jepsen_tpu.demo:demo_test",
+                "store-dir": store_dir,
+                "worker": worker.id,
+                "ledger": bool(ledger)}
+        if backends is not None:
+            spec["backend"] = backends.choose()
+        return spec
+
+    def run_lease(worker, conn, cell):
+        cid = cell["id"]
+        lease = table.grant(cid, worker.id, lease_s)
+        jr.append_event({"event": "lease", "cell": cid,
+                         "worker": worker.id, "lease-s": lease_s,
+                         "attempt": lease.attempt,
+                         "t": store.local_time()})
+        spec = cell_spec(cell, worker)
+        ctx = {"dir": cwd, "timeout": lease_s}
+        if env or spec.get("backend"):
+            ctx["env"] = dict(env or {})
+            if spec.get("backend"):
+                from . import backends as fbackends
+                ctx["env"].update(fbackends.tier_env(spec["backend"]))
+        ok = False
+        with tr.span("fleet.cell", cat="fleet",
+                     args={"cell": cid, "worker": worker.id,
+                           "attempt": lease.attempt}):
+            try:
+                res = conn.execute(
+                    ctx, {"cmd": f"{python} -m jepsen_tpu.fleet.worker",
+                          "in": json.dumps(spec, cls=store._Encoder)})
+            except Exception:  # noqa: BLE001 - transport crash
+                res = {"exit": -1, "err": traceback.format_exc(limit=4),
+                       "out": ""}
+        from .worker import parse_result
+        rec = parse_result(res.get("out")) if res.get("exit") == 0 \
+            else None
+        current = table.release(lease)
+        with cond:
+            if rec is not None:
+                rec.setdefault("worker", worker.id)
+                rec["attempt"] = lease.attempt
+                ok = finish(cid, rec)
+            else:
+                err = (res.get("err") or "")[-300:] \
+                    or f"exit {res.get('exit')}, no result line"
+                if current:   # the watchdog hasn't already requeued it
+                    requeue_or_fail(cid, worker.id, err)
+        return ok, res
+
+    def worker_loop(worker):
+        try:
+            conn = worker.connect()
+        except Exception as exc:  # noqa: BLE001
+            conn, exc_ = None, exc
+        if probe and conn is not None:
+            perr = worker.probe()
+        else:
+            perr = None if conn is not None else repr(exc_)
+        if perr is not None:
+            logger.warning("fleet worker %s failed its liveness probe: "
+                           "%s", worker.id, perr)
+            jr.append_event({"event": "worker-dead", "worker": worker.id,
+                             "error": str(perr)[:300],
+                             "t": store.local_time()})
+            reg.inc("fleet.worker_failures", worker=worker.id)
+            with cond:
+                alive.discard(worker.id)
+                cond.notify_all()
+            return
+        strikes = 0
+        try:
+            while True:
+                cell = next_cell()
+                if cell is None:
+                    break
+                try:
+                    ok, res = run_lease(worker, conn, cell)
+                except Exception:  # noqa: BLE001 - thread must live
+                    # an unexpected dispatch bug is a forfeited lease,
+                    # never a silently-dead worker thread (the cell
+                    # would otherwise hang until the lease watchdog)
+                    logger.warning("fleet worker %s: lease handling "
+                                   "crashed for %s", worker.id,
+                                   cell["id"], exc_info=True)
+                    with cond:
+                        requeue_or_fail(cell["id"], worker.id,
+                                        traceback.format_exc(limit=4))
+                    ok, res = False, {}
+                if ok or not remotes.transport_failed(res):
+                    strikes = 0
+                    continue
+                strikes += 1
+                reg.inc("fleet.worker_failures", worker=worker.id)
+                if strikes >= WORKER_STRIKES:
+                    logger.warning("retiring fleet worker %s after %d "
+                                   "consecutive transport failures",
+                                   worker.id, strikes)
+                    jr.append_event({"event": "worker-dead",
+                                     "worker": worker.id,
+                                     "error": f"{strikes} consecutive "
+                                              "transport failures",
+                                     "t": store.local_time()})
+                    break
+        finally:
+            with cond:
+                alive.discard(worker.id)
+                cond.notify_all()
+
+    if not workers:
+        raise FleetError("fleet dispatch needs at least one worker")
+    watchdog = robust.LeaseWatchdog(table, on_lease_expired,
+                                    poll_s=min(1.0, lease_s / 4))
+    hard_abort = None
+    cc_before = compile_cache.stats()
+    try:
+        with robust.signal_scope(latch):
+            with tr.span("fleet.dispatch", cat="fleet",
+                         args={"id": campaign_id, "cells": len(pending),
+                               "workers": len(workers)}):
+                watchdog.start()
+                threads = [threading.Thread(
+                    target=worker_loop, args=(w,),
+                    name=f"jepsen fleet {w.id}") for w in workers]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    while t.is_alive():
+                        t.join(timeout=0.5)
+    except BaseException as e:  # noqa: BLE001 - finalize, then rethrow
+        hard_abort = e
+        if not latch.is_set():
+            latch.set(repr(e))
+        logger.warning("fleet campaign %s hard-aborted (%r); journal "
+                       "is resumable with --resume", campaign_id, e)
+    finally:
+        watchdog.stop()
+
+    unfinished = set(ids) - terminal
+    if unfinished and not latch.is_set():
+        # every worker died with cells left: surface it as an abort so
+        # the exit code and status say "incomplete", not "passed"
+        latch.set("workers-exhausted")
+        logger.warning("fleet campaign %s: workers exhausted with %d "
+                       "cell(s) unfinished", campaign_id,
+                       len(unfinished))
+
+    # compile reuse: the coordinator itself compiles nothing -- sum the
+    # workers' own deltas from their records, then fold in the
+    # persisted ledger aggregate
+    recs = jr.latest()
+    cc = {"hits": 0, "misses": 0}
+    for r in recs:
+        w = r.get("compile-cache") or {}
+        cc["hits"] += int(w.get("hits") or 0)
+        cc["misses"] += int(w.get("misses") or 0)
+    local = compile_cache.delta(cc_before)
+    cc["hits"] += local["hits"]
+    cc["misses"] += local["misses"]
+    reg.set_gauge("campaign.compile_cache.hits", cc["hits"])
+    reg.set_gauge("campaign.compile_cache.misses", cc["misses"])
+    if led is not None:
+        led.note_stats(cc["hits"], cc["misses"])
+        try:
+            cc = dict(cc, ledger=led.stats())
+        except Exception:  # noqa: BLE001 - bookkeeping only
+            logger.warning("couldn't aggregate compile-ledger stats",
+                           exc_info=True)
+    aborted = latch.is_set()
+    report = creport.summarize(
+        recs, meta={"id": campaign_id}, compile_cache=cc,
+        aborted=aborted, abort_reason=latch.reason, skipped=len(done))
+    report["mode"] = "fleet"
+    report["workers"] = [w.id for w in workers]
+    jr.write_report(report)
+    try:
+        tr.dump(store.campaign_path(campaign_id, "trace.jsonl"))
+        store._dump_json(reg.snapshot(),
+                         store.campaign_path(campaign_id,
+                                             "metrics.json"))
+    except Exception:  # noqa: BLE001 - telemetry is a byproduct
+        logger.warning("couldn't write fleet obs artifacts",
+                       exc_info=True)
+    jr.write_meta({**(jr.load_meta() or {}),
+                   "status": "aborted" if aborted else "complete",
+                   "updated": store.local_time()})
+    if hard_abort is not None:
+        raise hard_abort
+    return report

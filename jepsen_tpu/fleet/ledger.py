@@ -1,0 +1,238 @@
+"""The disk-persistent compile ledger: cross-PROCESS compile-reuse
+knowledge under ``store/compile_ledger/``.
+
+``campaign.compile_cache`` already answers "has a shape-identical
+search run in this process?" -- the in-memory face of jax's jit cache.
+What it cannot see is history: a campaign re-started after a crash, or
+two concurrent campaign processes on one host sharing a persistent jax
+compilation cache, re-count every shape as a cold miss. This module is
+the durable half: every first sighting of a compile plan appends one
+JSON line to ``ledger.jsonl``, and ``refresh()`` folds lines appended
+by *other* processes into the reader's view, so a shape any process
+has planned counts as a hit everywhere afterwards.
+
+Disk discipline matches the campaign journal (``cells.jsonl``):
+
+* appends happen under an ``fcntl`` exclusive lock (concurrent
+  *processes* interleave whole lines, never bytes) and are
+  flushed+fsynced before the lock drops;
+* a process killed mid-append leaves a torn final line; the next
+  appender terminates the fragment in place and readers skip it;
+* records are never rewritten -- stats land as separate ``"stats"``
+  event lines (one per campaign finalize), and ``stats()`` aggregates
+  the whole file.
+
+Keys are canonicalized through a JSON round trip before comparison, so
+a tuple noted live and the same tuple re-read from disk are equal.
+
+Deliberately dependency-light (store + stdlib): compile_cache imports
+this from inside ``note()`` and nothing here may drag the heavy
+scheduler/checker chain back in.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+from .. import store
+
+try:
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX
+    fcntl = None
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["LEDGER_FILE", "Ledger", "canon_key", "attach", "attached",
+           "detach"]
+
+LEDGER_FILE = "ledger.jsonl"
+
+
+def canon_key(engine, key):
+    """The canonical (hashable) form of one compile-plan key: what a
+    live ``note()`` computes and what a ledger line parses back to
+    must be equal, so both go through one JSON round trip."""
+    raw = json.loads(json.dumps(list(key), cls=store._Encoder))
+    return (str(engine),
+            tuple(tuple(x) if isinstance(x, list) else x for x in raw))
+
+
+class Ledger:
+    """One process's handle on the shared on-disk ledger."""
+
+    def __init__(self, dir=None):  # noqa: A002 - mirrors open()
+        self.dir = os.path.abspath(dir or store.compile_ledger_path())
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, LEDGER_FILE)
+        self._lock = threading.Lock()
+        self._offset = 0        # how far refresh() has parsed
+        self._keys = set()
+
+    # -- reading --------------------------------------------------------
+
+    def refresh(self):
+        """Fold lines other processes appended since the last refresh
+        into this handle's key set; returns the full set. A torn final
+        line (a writer mid-append, or one that died there) is left
+        unparsed -- the offset stays before it, so a later refresh
+        picks the completed line up."""
+        with self._lock:
+            try:
+                with open(self.path, "rb") as f:
+                    f.seek(self._offset)
+                    chunk = f.read()
+            except FileNotFoundError:
+                return set(self._keys)
+            consumed = 0
+            for line in chunk.split(b"\n")[:-1]:   # last piece: no \n yet
+                consumed += len(line) + 1
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    # an interior fragment: a previous writer's torn
+                    # tail that a later appender terminated in place
+                    logger.warning("skipping torn compile-ledger line")
+                    continue
+                if isinstance(rec, dict) and "key" in rec:
+                    try:
+                        self._keys.add(
+                            canon_key(rec.get("engine"), rec["key"]))
+                    except TypeError:
+                        logger.warning("unhashable compile-ledger key "
+                                       "skipped: %r", rec)
+            self._offset += consumed
+            return set(self._keys)
+
+    def keys(self):
+        with self._lock:
+            return set(self._keys)
+
+    # -- writing --------------------------------------------------------
+
+    def _append(self, rec):
+        line = json.dumps(rec, cls=store._Encoder)
+        with self._lock:
+            with open(self.path, "a+b") as f:
+                if fcntl is not None:
+                    fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+                try:
+                    # terminate a torn tail (a writer killed mid-append)
+                    # so this record never merges into the fragment
+                    f.seek(0, os.SEEK_END)
+                    if f.tell() > 0:
+                        f.seek(-1, os.SEEK_END)
+                        if f.read(1) != b"\n":
+                            f.write(b"\n")
+                    f.write(line.encode() + b"\n")
+                    f.flush()
+                    try:
+                        os.fsync(f.fileno())
+                    except OSError:  # pragma: no cover - exotic fs
+                        pass
+                finally:
+                    if fcntl is not None:
+                        fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+    def record(self, engine, key):
+        """Persist one first-sighting (a compile miss). Failures are
+        contained: the ledger is bookkeeping, never verdict-bearing."""
+        k = canon_key(engine, key)
+        try:
+            self._append({"engine": k[0], "key": list(k[1]),
+                          "pid": os.getpid(), "t": store.local_time()})
+        except Exception:  # noqa: BLE001 - telemetry only
+            logger.warning("compile-ledger append failed", exc_info=True)
+            return
+        with self._lock:
+            self._keys.add(k)
+
+    def note_stats(self, hits, misses):
+        """Append one process's hit/miss delta as a stats event (the
+        campaign scheduler calls this at finalize), so the persisted
+        ledger carries reuse evidence, not just shapes."""
+        try:
+            self._append({"stats": {"hits": int(hits),
+                                    "misses": int(misses)},
+                          "pid": os.getpid(), "t": store.local_time()})
+        except Exception:  # noqa: BLE001 - telemetry only
+            logger.warning("compile-ledger stats append failed",
+                           exc_info=True)
+
+    # -- aggregation ----------------------------------------------------
+
+    def stats(self):
+        """Whole-file aggregate: distinct shapes, summed hit/miss
+        deltas across every process that ever reported, and the
+        contributing pids."""
+        shapes, hits, misses, pids = set(), 0, 0, set()
+        try:
+            with open(self.path, "rb") as f:
+                lines = f.read().split(b"\n")
+        except FileNotFoundError:
+            lines = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if "key" in rec:
+                try:
+                    shapes.add(canon_key(rec.get("engine"), rec["key"]))
+                except TypeError:
+                    pass
+            st = rec.get("stats")
+            if isinstance(st, dict):
+                hits += int(st.get("hits") or 0)
+                misses += int(st.get("misses") or 0)
+            if rec.get("pid") is not None:
+                pids.add(rec["pid"])
+        return {"path": self.path, "shapes": len(shapes),
+                "hits": hits, "misses": misses,
+                "processes": len(pids)}
+
+
+def attach(dir=None):  # noqa: A002 - mirrors Ledger
+    """Attach a persistent ledger to ``campaign.compile_cache`` (the
+    note() path consults it from then on) and seed the in-memory seen
+    set from disk, so shapes compiled by earlier/concurrent processes
+    count as hits immediately. Idempotent per directory: re-attaching
+    the same directory reuses the live handle (nested campaign runs in
+    one process must not reset each other's offsets)."""
+    from ..campaign import compile_cache
+    led = compile_cache.get_ledger()
+    target = os.path.abspath(dir or store.compile_ledger_path())
+    if led is not None and led.dir == target:
+        return led
+    led = Ledger(target)
+    led.refresh()
+    compile_cache.set_ledger(led)
+    return led
+
+
+def attached():
+    """The currently attached Ledger, or None."""
+    from ..campaign import compile_cache
+    return compile_cache.get_ledger()
+
+
+def detach(expected=None):
+    """Detach the persistent ledger (in-memory counting continues).
+    With ``expected``, detaches only if that handle is still the
+    attached one -- overlapping campaigns must not sever a sibling's
+    ledger."""
+    from ..campaign import compile_cache
+    if expected is not None and compile_cache.get_ledger() is not expected:
+        return
+    compile_cache.set_ledger(None)

@@ -1,0 +1,369 @@
+"""Checking-as-a-service: the logic behind web.py's ``/api/`` routes.
+
+The web server grew up from a store viewer into a submission API --
+external traffic can POST work instead of running the harness locally:
+
+* ``POST /api/check`` -- one history JSON in, one verdict out.
+  Pipeline: histlint (malformed histories are a 400 with the
+  diagnostics, not a garbage verdict -- the same preconditions the
+  offline checker relies on), then the SAME one-engine dispatch the
+  streaming monitor uses (``monitor/engine.py check_prefix``), so the
+  service's verdict is by construction the offline checker's verdict
+  on that history. Keyed ([k, v]-valued) histories split per key like
+  ``independent`` does and merge validity the same way.
+* ``POST /api/campaigns`` -- a sweep-matrix JSON in, a campaign id
+  out; the campaign runs on a background thread through the ordinary
+  campaign scheduler (journal, ledger, resume semantics all apply)
+  and its status polls at ``GET /api/campaigns/<id>``.
+* **Shutdown.** Every submitted campaign's latch chains off one
+  service-wide ``robust.AbortLatch``; ``shutdown()`` flips it, so
+  stopping the service gracefully aborts (and leaves resumable) every
+  campaign it accepted.
+
+Transport-level hardening (size limits, JSON errors) lives in
+web.Handler; this module is pure request logic so it tests without a
+socket.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+
+from .. import robust, store
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["MAX_BODY_BYTES", "ApiError", "check_history",
+           "submit_campaign", "campaign_status", "latch", "shutdown",
+           "reset"]
+
+#: request-body ceiling enforced by web.Handler BEFORE reading
+MAX_BODY_BYTES = 16 << 20
+
+#: device-engine wall budget for one /api/check (seconds); payloads
+#: may lower it, never raise it past the cap
+CHECK_TIMEOUT_S = 30.0
+CHECK_TIMEOUT_CAP_S = 120.0
+
+#: histories larger than this are refused outright: the check is
+#: NP-hard and a service must bound the work it accepts
+MAX_CHECK_OPS = 200_000
+
+
+class ApiError(Exception):
+    """An HTTP-shaped request failure."""
+
+    def __init__(self, status, message, **extra):
+        self.status = int(status)
+        self.payload = {"error": str(message), **extra}
+        super().__init__(str(message))
+
+
+_lock = threading.Lock()
+_latch = None
+_campaigns = {}     # campaign id -> {"thread", "latch", "submitted"}
+
+
+def latch():
+    """The service-wide abort latch (created on first use)."""
+    global _latch
+    with _lock:
+        if _latch is None:
+            _latch = robust.AbortLatch()
+        return _latch
+
+
+def shutdown(reason="service-shutdown", join_s=10.0):
+    """Honor the shared AbortLatch: flip it so every accepted campaign
+    aborts gracefully (journals stay resumable), then give their
+    threads a bounded join."""
+    latch().set(reason)
+    with _lock:
+        threads = [c["thread"] for c in _campaigns.values()]
+    deadline = time.monotonic() + join_s
+    for t in threads:
+        t.join(timeout=max(0.1, deadline - time.monotonic()))
+
+
+def reset():
+    """Forget service state (tests)."""
+    global _latch
+    with _lock:
+        _latch = None
+        _campaigns.clear()
+
+
+# ---------------------------------------------------------------------------
+# POST /api/check
+
+def _require(payload, key, types, what):
+    v = payload.get(key)
+    if not isinstance(v, types):
+        raise ApiError(400, f"{key!r} must be {what}")
+    return v
+
+
+def _split_keyed(hist):
+    """Per-key subhistories of an [k, v]-valued history, mirroring
+    independent.subhistory (each key checks alone; P-compositionality
+    is what makes the split sound). JSON has no tuple type, so every
+    2-element list value is coerced to an independent.Tuple first --
+    the caller opted into keyed semantics, so that reading is the
+    declared one."""
+    from .. import independent
+    coerced = []
+    for op in hist:
+        v = op.get("value")
+        if isinstance(v, (list, tuple)) and len(v) == 2 \
+                and not independent.is_tuple(v):
+            op = dict(op)
+            op["value"] = independent.tuple_(v[0], v[1])
+        coerced.append(op)
+    hist = coerced
+    keys = independent.history_keys(hist)
+    if not keys:
+        raise ApiError(400, "keyed check requested but no op carries "
+                            "an [key, value] tuple value")
+    return {k: independent.subhistory(k, hist) for k in keys}
+
+
+def check_history(payload):
+    """The /api/check pipeline; returns the response dict or raises
+    ApiError. Payload keys: ``history`` (list of op maps, required),
+    ``model`` (name, default cas-register), ``engine`` (jax-wgl /
+    linear / wgl, default jax-wgl), ``keyed`` (bool), ``init-ops``,
+    ``timeout-s``."""
+    from ..analysis import histlint, errors as diag_errors
+    from ..checker.checkers import Linearizable
+    from ..models import model_spec
+    from ..monitor import engine as mengine
+
+    if not isinstance(payload, dict):
+        raise ApiError(400, "request body must be a JSON object")
+    hist = _require(payload, "history", list, "a list of op maps")
+    if len(hist) > MAX_CHECK_OPS:
+        raise ApiError(413, f"history has {len(hist)} events; this "
+                            f"service accepts at most {MAX_CHECK_OPS}")
+    model = payload.get("model", "cas-register")
+    try:
+        spec = model_spec(str(model))
+    except KeyError as e:
+        raise ApiError(400, str(e)) from None
+    engine = payload.get("engine", "jax-wgl")
+    if engine not in mengine.ENGINES:
+        raise ApiError(400, f"unknown engine {engine!r}; known: "
+                            f"{list(mengine.ENGINES)}")
+    timeout_s = payload.get("timeout-s")
+    if timeout_s is not None and (not isinstance(timeout_s, (int, float))
+                                  or isinstance(timeout_s, bool)
+                                  or timeout_s <= 0):
+        raise ApiError(400, f"timeout-s must be a positive number, "
+                            f"got {timeout_s!r}")
+    timeout_s = min(float(timeout_s or CHECK_TIMEOUT_S),
+                    CHECK_TIMEOUT_CAP_S)
+
+    # -- histlint: refuse malformed histories with the diagnostics ----
+    diags = histlint.lint_history(hist, model_fs=set(spec.f_codes))
+    errs = diag_errors(diags)
+    if errs:
+        raise ApiError(
+            400, "history failed histlint",
+            diagnostics=[{"code": d.code, "message": d.message,
+                          "location": d.location} for d in errs[:20]])
+
+    from .. import history as jhistory
+    hist = jhistory.index([dict(o) for o in hist])
+    lin = Linearizable(spec, engine,
+                       init_ops=payload.get("init-ops"))
+    # ONE wall budget for the whole request, not per key: a keyed
+    # history with many hard keys must not multiply the cap
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+
+    def check_one(sub):
+        left = deadline - time.monotonic()
+        if left <= 0:
+            return {"valid": "unknown",
+                    "error": "request timeout budget exhausted"}
+        engine_opts = {"timeout_s": left} if engine == "jax-wgl" \
+            else None
+        client = lin.prepare_history(jhistory.client_ops(sub))
+        e, init_state = spec.encode(client)
+        r = mengine.check_prefix(spec, e, init_state, engine=engine,
+                                 engine_opts=engine_opts)
+        return {"valid": r.get("valid"), "ops": len(e),
+                **({"error": str(r["error"])} if r.get("error")
+                   else {})}
+
+    try:
+        if payload.get("keyed"):
+            from ..checker.core import merge_valid
+            per_key = {str(k): check_one(sub)
+                       for k, sub in sorted(_split_keyed(hist).items(),
+                                            key=lambda kv: str(kv[0]))}
+            out = {"valid": merge_valid([r["valid"]
+                                         for r in per_key.values()]),
+                   "keys": per_key}
+        else:
+            out = check_one(hist)
+    except ApiError:
+        raise
+    except Exception as exc:  # noqa: BLE001 - bad input, not a 500
+        logger.warning("/api/check failed", exc_info=True)
+        raise ApiError(422, f"history could not be checked: "
+                            f"{exc!r}") from None
+    out.update({"model": spec.name, "engine": engine,
+                "events": len(hist),
+                "wall_s": round(time.monotonic() - t0, 3),
+                "histlint": {"warnings": len(diags) - len(errs)}})
+    from .. import obs
+    obs.inc("fleet.api_checks", valid=str(out.get("valid")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# POST /api/campaigns + GET /api/campaigns/<id>
+
+#: default base options submitted campaigns build cells from (the demo
+#: suite's no-ssh shape); a payload's "options" overlay these
+DEFAULT_OPTIONS = {
+    "nodes": ["n1"], "concurrency": 1, "ssh": {"dummy?": True},
+    "time-limit": 5, "workload": "register",
+}
+
+#: option keys a remote payload may NOT override: anything that would
+#: point the server's control plane at real hosts or local files.
+#: Submitted campaigns ALWAYS run on the dummy remote -- a caller who
+#: can POST here must not be able to make this process open SSH
+#: connections (or read key files) of its choosing.
+PROTECTED_OPTIONS = ("nodes-file", "nodes", "node", "ssh",
+                     "ssh-private-key", "leave-db-running?")
+
+_SAFE_ID = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._+=,-]*$")
+
+
+def _safe_campaign_id(cid):
+    """Campaign ids from the wire become filesystem path components
+    (store/campaigns/<id>/...): refuse anything that isn't a plain
+    token, or a crafted id escapes the store on both read and write."""
+    cid = str(cid)
+    if not _SAFE_ID.fullmatch(cid) or len(cid) > 200:
+        raise ApiError(400, f"invalid campaign id {cid!r}: use "
+                            "letters, digits, and ._+=,- only")
+    return cid
+
+
+def submit_campaign(payload, builder=None):
+    """Accept a sweep matrix; returns (campaign_id, meta dict). The
+    campaign runs on a daemon thread via the ordinary scheduler with a
+    latch chained off the service latch."""
+    from ..campaign import plan as cplan
+    from ..campaign import run_cells, CampaignError
+
+    if not isinstance(payload, dict):
+        raise ApiError(400, "request body must be a JSON object")
+    axes = _require(payload, "axes", dict, "an {axis: [values]} object")
+    matrix = {"axes": axes, "base": payload.get("base") or {}}
+    try:
+        cells_plan, diags = cplan.validate(matrix)
+    except cplan.CampaignPlanError as e:
+        raise ApiError(400, f"campaign matrix invalid: {e}") from None
+    options = dict(DEFAULT_OPTIONS)
+    overlay = payload.get("options") or {}
+    if not isinstance(overlay, dict):
+        raise ApiError(400, "'options' must be an object")
+    overlay = {k: v for k, v in overlay.items()
+               if k not in PROTECTED_OPTIONS}
+    options.update(overlay)
+    # belt and braces on top of PROTECTED_OPTIONS: whatever the
+    # payload said, a submitted campaign runs on the dummy remote
+    options["ssh"] = {"dummy?": True}
+
+    def _pos_int(key):
+        v = payload.get(key)
+        if v is None:
+            return 1
+        if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+            raise ApiError(400, f"{key!r} must be a positive integer, "
+                                f"got {v!r}")
+        return v
+
+    parallel = _pos_int("parallel")
+    device_slots = _pos_int("device-slots")
+    campaign_id = _safe_campaign_id(payload.get("id") or
+                                    "api-" + store.local_time())
+    if campaign_id in _campaigns:
+        raise ApiError(409, f"campaign {campaign_id!r} already "
+                            "submitted")
+
+    from ..fleet.worker import resolve_builder
+    build_fn = resolve_builder(builder or "jepsen_tpu.demo:demo_test")
+    build_lock = threading.Lock()
+
+    def build(params):
+        import random
+        o = dict(options)
+        o.update(params)
+        with build_lock:
+            if "seed" in params:
+                random.seed(params["seed"])
+            return build_fn(o)
+
+    cells = [{"id": c["id"], "group": c["group"],
+              "params": c["params"], "build": build}
+             for c in cells_plan]
+    child = robust.ChainedLatch(parent=latch())
+
+    def run():
+        try:
+            run_cells(cells, campaign_id=campaign_id,
+                      parallel=parallel, device_slots=device_slots,
+                      latch=child)
+        except CampaignError as e:
+            logger.warning("submitted campaign %s refused: %s",
+                           campaign_id, e)
+        except Exception:  # noqa: BLE001 - background thread
+            logger.warning("submitted campaign %s crashed",
+                           campaign_id, exc_info=True)
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"jepsen api campaign {campaign_id}")
+    with _lock:
+        _campaigns[campaign_id] = {"thread": t, "latch": child,
+                                   "submitted": store.local_time()}
+    t.start()
+    from .. import obs
+    obs.inc("fleet.api_campaigns")
+    return campaign_id, {"campaign": campaign_id,
+                         "cells": [c["id"] for c in cells_plan],
+                         "status-url": f"/api/campaigns/{campaign_id}",
+                         "warnings": len(diags)}
+
+
+def campaign_status(campaign_id):
+    """The pollable status body for one campaign (submitted via the
+    API or any other way -- the store is the truth)."""
+    campaign_id = _safe_campaign_id(campaign_id)
+    data = store.load_campaign(campaign_id)
+    with _lock:
+        sub = _campaigns.get(campaign_id)
+    if data is None and sub is None:
+        raise ApiError(404, f"unknown campaign {campaign_id!r}")
+    meta = (data or {}).get("meta") or {}
+    records = store.latest_campaign_records(campaign_id) if data else []
+    out = {"campaign": campaign_id,
+           "status": meta.get("status") or "submitted",
+           "cells-planned": len(meta.get("cells") or []),
+           "cells-done": len(records),
+           "outcomes": {},
+           "records": records}
+    for r in records:
+        k = str(r.get("outcome"))
+        out["outcomes"][k] = out["outcomes"].get(k, 0) + 1
+    if data and data.get("report"):
+        out["report"] = {k: v for k, v in data["report"].items()
+                         if k not in ("cells", "results")}
+    return out

@@ -13,6 +13,12 @@ not. This package holds the pieces that enforce that asymmetry:
   deadline. The interpreter stops new invocations at the generator
   boundary, drains outstanding ops for a grace period, and returns the
   partial history; a second signal hard-aborts.
+* :mod:`.leases` -- bounded work ownership: a `LeaseTable` of
+  per-unit grants plus a `LeaseWatchdog` monitor thread that hands
+  expired leases to a steal callback. The fleet dispatcher
+  (jepsen_tpu.fleet.dispatch) uses it as the backstop behind its
+  per-exec transport timeouts, so a wedged ssh cannot strand a
+  campaign cell.
 * :mod:`.watchdog` -- the wedged-worker watchdog: a monitor thread
   enforcing `test["op-timeout-ms"]` per dispatched op. On expiry the
   op completes as ``:info`` with ``error="harness-timeout"``, the
@@ -34,8 +40,10 @@ preserved byte-for-byte on the happy path.
 from __future__ import annotations
 
 from .abort import AbortLatch, ChainedLatch, signal_scope
+from .leases import Lease, LeaseTable, LeaseWatchdog
 from .retry import RetryPolicy
 from .watchdog import OpWatchdog, WATCHDOG_FIRED
 
 __all__ = ["AbortLatch", "ChainedLatch", "signal_scope", "RetryPolicy",
-           "OpWatchdog", "WATCHDOG_FIRED"]
+           "OpWatchdog", "WATCHDOG_FIRED", "Lease", "LeaseTable",
+           "LeaseWatchdog"]

@@ -52,6 +52,11 @@ JOURNAL_FILE = "history.jsonl.journal"
 #: name is reserved -- test_names() skips it
 CAMPAIGNS_DIR = "campaigns"
 
+#: directory under base_dir holding the disk-persistent compile ledger
+#: (``store/compile_ledger/ledger.jsonl``, written by
+#: jepsen_tpu.fleet.ledger); reserved -- test_names() skips it
+COMPILE_LEDGER_DIR = "compile_ledger"
+
 TIME_FORMAT = "%Y%m%dT%H%M%S.%f%z"
 
 
@@ -402,6 +407,12 @@ def campaign_path(campaign_id, *args):
                         *map(str, args))
 
 
+def compile_ledger_path(*args):
+    """The disk-persistent compile ledger's directory (or a file inside
+    it): ``base_dir/compile_ledger/...`` (jepsen_tpu.fleet.ledger)."""
+    return os.path.join(base_dir, COMPILE_LEDGER_DIR, *map(str, args))
+
+
 def campaigns():
     """All campaign ids in the store (those with a campaign.json)."""
     root = os.path.join(base_dir, CAMPAIGNS_DIR)
@@ -450,11 +461,26 @@ def latest_campaign_records(campaign_id):
     """One record per cell, latest wins -- THE fold every consumer of
     the journal must agree on (resume skipping, the final report, the
     web view): a resumed campaign's journal keeps superseded records
-    (e.g. an "aborted" row under the re-run's terminal row)."""
+    (e.g. an "aborted" row under the re-run's terminal row).
+
+    Event records (``"event"`` key: fleet lease bookkeeping appended by
+    jepsen_tpu.fleet.dispatch) are NOT outcomes and never participate
+    in this fold -- a lease line after a terminal record must not
+    resurrect the cell, and a lease with no terminal record must not
+    read as completed. ``campaign_events`` reads them instead."""
     latest = {}
     for rec in load_campaign_records(campaign_id):
+        if rec.get("event"):
+            continue
         latest[rec.get("cell")] = rec
     return list(latest.values())
+
+
+def campaign_events(campaign_id):
+    """The journal's event records (lease grants/failures appended by
+    the fleet dispatcher), append order."""
+    return [rec for rec in load_campaign_records(campaign_id)
+            if rec.get("event")]
 
 
 def load_campaign_records(campaign_id):
@@ -492,7 +518,8 @@ def test_names():
             d for d in os.listdir(base_dir)
             if os.path.isdir(os.path.join(base_dir, d))
             and not os.path.islink(os.path.join(base_dir, d))
-            and d not in ("latest", "current", CAMPAIGNS_DIR))
+            and d not in ("latest", "current", CAMPAIGNS_DIR,
+                          COMPILE_LEDGER_DIR))
     except FileNotFoundError:
         return []
 

@@ -1,9 +1,24 @@
 """Web interface: a minimal HTTP server for browsing the store directory
-(reference jepsen/src/jepsen/web.clj).
+(reference jepsen/src/jepsen/web.clj), grown into the fleet's
+submission API.
 
 Home page lists tests with validity-colored rows (web.clj:104-134); test
 directories are browsable with file streaming and whole-dir zip download
 (web.clj:262-303), with a path-traversal guard (web.clj:304-309).
+
+The ``/api/`` routes turn the viewer into checking-as-a-service
+(jepsen_tpu.fleet.service holds the request logic)::
+
+    POST /api/check           history JSON -> verdict
+    POST /api/campaigns       sweep matrix -> campaign id (202)
+    GET  /api/campaigns       submitted/stored campaign ids
+    GET  /api/campaigns/<id>  pollable status + records
+
+API transport hardening lives here: request bodies are refused (413)
+when Content-Length exceeds ``service.MAX_BODY_BYTES`` -- BEFORE any
+read, so an adversarial body can't balloon memory -- reads are bounded
+to the declared length, and every /api/* error (400/404/405/411/413)
+is a JSON object, never an HTML page.
 """
 
 from __future__ import annotations
@@ -232,10 +247,98 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_json(self, code, obj):
+        return self._send(code, json.dumps(obj, cls=store._Encoder),
+                          "application/json; charset=utf-8")
+
+    def _read_json_body(self):
+        """Bounded request-body read: the declared Content-Length is
+        validated BEFORE any byte is read, so an oversized body gets a
+        413 instead of an OOM read, and the read itself never exceeds
+        the declared length."""
+        from .fleet.service import ApiError, MAX_BODY_BYTES
+        cl = self.headers.get("Content-Length")
+        if cl is None:
+            raise ApiError(411, "Content-Length required")
+        try:
+            n = int(cl)
+        except (TypeError, ValueError):
+            raise ApiError(400, f"bad Content-Length {cl!r}") from None
+        if n < 0:
+            raise ApiError(400, f"bad Content-Length {cl!r}")
+        if n > MAX_BODY_BYTES:
+            # don't read a byte of it; drop the connection after
+            # responding so the still-sending client can't wedge us
+            self.close_connection = True
+            raise ApiError(413, f"request body of {n} bytes exceeds "
+                                f"the {MAX_BODY_BYTES}-byte limit")
+        body = self.rfile.read(n)
+        try:
+            return json.loads(body)
+        except ValueError:
+            raise ApiError(400, "request body is not valid JSON") \
+                from None
+
+    def _api(self, method, path):
+        """The /api/* routes: JSON in, JSON out, JSON errors."""
+        from .fleet import service
+        try:
+            clean = path.rstrip("/")
+            if clean == "/api/check":
+                if method != "POST":
+                    raise service.ApiError(
+                        405, "POST a {'history': [...]} body here")
+                return self._send_json(
+                    200, service.check_history(self._read_json_body()))
+            if clean == "/api/campaigns":
+                if method == "POST":
+                    _cid, meta = service.submit_campaign(
+                        self._read_json_body())
+                    return self._send_json(202, meta)
+                if method != "GET":
+                    raise service.ApiError(405, "GET or POST only")
+                return self._send_json(200,
+                                       {"campaigns": store.campaigns()})
+            if clean.startswith("/api/campaigns/"):
+                if method != "GET":
+                    raise service.ApiError(405, "GET only")
+                cid = clean[len("/api/campaigns/"):]
+                return self._send_json(200,
+                                       service.campaign_status(cid))
+            raise service.ApiError(404, f"unknown API route {path!r}")
+        except service.ApiError as e:
+            return self._send_json(e.status, e.payload)
+        except BrokenPipeError:
+            pass
+        except Exception:  # noqa: BLE001
+            logger.warning("api handler error", exc_info=True)
+            try:
+                self._send_json(500, {"error": "internal error"})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        try:
+            path = urllib.parse.unquote(
+                urllib.parse.urlparse(self.path).path)
+            if path.startswith("/api/"):
+                return self._api("POST", path)
+            return self._send(404, "<h1>404</h1>")
+        except BrokenPipeError:
+            pass
+        except Exception:  # noqa: BLE001
+            logger.warning("web handler error", exc_info=True)
+            try:
+                self._send(500, "<h1>500</h1>")
+            except Exception:  # noqa: BLE001
+                pass
+
     def do_GET(self):  # noqa: N802 - http.server API
         try:
             path = urllib.parse.unquote(
                 urllib.parse.urlparse(self.path).path)
+            if path.startswith("/api/"):
+                return self._api("GET", path)
             if path in ("", "/"):
                 return self._send(200, _home_page())
             if path.rstrip("/") == "/campaigns":

@@ -1,0 +1,767 @@
+"""Fleet subsystem tests: the disk-persistent compile ledger, lease
+tables + expiry watchdog, loopback worker dispatch (including the
+kill -9 work-stealing acceptance test), the /api/ service routes with
+the web.Handler hardening (413 before read), backend failover
+tiering, and planlint PL014."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import robust, store, web
+from jepsen_tpu.campaign import compile_cache, plan, scheduler
+from jepsen_tpu.campaign.journal import CampaignJournal
+from jepsen_tpu.analysis import planlint
+from jepsen_tpu.fleet import backends as fbackends
+from jepsen_tpu.fleet import dispatch, ledger as fledger, service
+from jepsen_tpu.fleet import worker as fworker
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+    compile_cache.reset()
+    service.reset()
+    yield
+    compile_cache.reset()
+    service.reset()
+
+
+# ---------------------------------------------------------------------------
+# ledger: persistence, cross-process visibility, torn tails
+
+
+def test_ledger_survives_process_restart():
+    fledger.attach()
+    assert compile_cache.note("e", ("spec", 64, True)) is False
+    assert compile_cache.note("e", ("spec", 64, True)) is True
+    # simulate a restart: wipe ALL in-memory state, re-attach from disk
+    compile_cache.reset()
+    fledger.attach()
+    assert compile_cache.note("e", ("spec", 64, True)) is True
+    s = compile_cache.stats()
+    assert s["hits"] == 1 and s["misses"] == 0
+
+
+def test_ledger_sees_sibling_process_appends():
+    fledger.attach()
+    # a "sibling process": an independent handle on the same file
+    sibling = fledger.Ledger(store.compile_ledger_path())
+    sibling.record("e", ("other-shape", 128))
+    # never seen locally, but note() re-reads the file before a miss
+    assert compile_cache.note("e", ("other-shape", 128)) is True
+
+
+def test_ledger_cross_process_for_real(tmp_path):
+    """An actual second python process appends; this one hits."""
+    d = store.compile_ledger_path()
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from jepsen_tpu.fleet import ledger as fl\n"
+        "fl.Ledger(%r).record('e', ('from-child', 7))\n"
+        % (os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), d))
+    subprocess.run([sys.executable, "-c", code], check=True,
+                   timeout=60)
+    fledger.attach()
+    assert compile_cache.note("e", ("from-child", 7)) is True
+
+
+def test_ledger_torn_tail_and_fragment_tolerated():
+    led = fledger.attach()
+    led.record("e", ("good", 1))
+    with open(led.path, "ab") as f:
+        f.write(b'{"engine": "e", "key": [trunc')   # torn tail
+    # a fresh reader skips the fragment but keeps the good line
+    led2 = fledger.Ledger(led.dir)
+    assert len(led2.refresh()) == 1
+    # the next appender terminates the fragment in place
+    led2.record("e", ("after-tear", 2))
+    led3 = fledger.Ledger(led.dir)
+    assert len(led3.refresh()) == 2
+    assert led3.stats()["shapes"] == 2
+
+
+def test_ledger_stats_aggregate_across_processes():
+    led = fledger.attach()
+    led.record("e", ("s1", 1))
+    led.note_stats(5, 2)
+    sibling = fledger.Ledger(led.dir)
+    sibling.note_stats(3, 1)
+    st = led.stats()
+    assert st["hits"] == 8 and st["misses"] == 3
+    assert st["shapes"] == 1
+
+
+def test_ledger_attach_is_idempotent_per_dir():
+    led = fledger.attach()
+    assert fledger.attach() is led
+    assert fledger.attached() is led
+    fledger.detach(expected=fledger.Ledger(led.dir))  # not the live one
+    assert fledger.attached() is led
+    fledger.detach(expected=led)
+    assert fledger.attached() is None
+
+
+def test_canon_key_roundtrips_json_types():
+    import numpy as np
+    k = fledger.canon_key("e", ("spec", np.int64(64), True, 2.5))
+    assert k == ("e", ("spec", 64, True, 2.5))
+    # and equals the parse of its own serialized form
+    rt = json.loads(json.dumps(list(k[1])))
+    assert fledger.canon_key("e", rt) == k
+
+
+def test_run_cells_reports_ledger_block():
+    from jepsen_tpu import tests as tst
+    t = tst.noop_test()
+    t.update({"ssh": {"dummy?": True}, "obs?": False, "name": "led",
+              "nodes": ["n1"], "concurrency": 1})
+    rep = scheduler.run_cells([{"id": "a", "test": t}],
+                              campaign_id="led")
+    cc = rep["compile_cache"]
+    assert "ledger" in cc and cc["ledger"]["path"].endswith(
+        "ledger.jsonl")
+    assert os.path.exists(cc["ledger"]["path"])
+    # --no-ledger equivalent: no block, nothing on disk
+    store.delete()
+    compile_cache.reset()
+    rep = scheduler.run_cells([{"id": "a", "test": dict(t)}],
+                              campaign_id="led2", ledger=False)
+    assert "ledger" not in rep["compile_cache"]
+
+
+# ---------------------------------------------------------------------------
+# journal events
+
+
+def test_journal_events_never_fold_into_outcomes():
+    jr = CampaignJournal("ev")
+    jr.append_event({"event": "lease", "cell": "a", "worker": "w1"})
+    assert jr.latest() == []           # a lease is not an outcome
+    assert jr.completed() == {}
+    jr.append_cell({"cell": "a", "outcome": True})
+    jr.append_event({"event": "lease-expired", "cell": "a",
+                     "worker": "w1"})
+    latest = jr.latest()
+    assert len(latest) == 1 and latest[0]["outcome"] is True
+    assert "a" in jr.completed()       # the late event didn't resurrect
+    assert [e["event"] for e in jr.events()] == ["lease",
+                                                 "lease-expired"]
+    with pytest.raises(AssertionError):
+        jr.append_cell({"cell": "b", "event": "lease"})
+    with pytest.raises(AssertionError):
+        jr.append_event({"cell": "b", "outcome": True})
+
+
+# ---------------------------------------------------------------------------
+# planlint PL014
+
+
+def _codes(diags):
+    return [(d.code, d.severity) for d in diags]
+
+
+def test_pl014_worker_rules():
+    assert planlint.lint_fleet({"workers": ["a", "b"],
+                                "lease-s": 600}) == []
+    diags = planlint.lint_fleet({"workers": []})
+    assert ("PL014", "error") in _codes(diags)
+    diags = planlint.lint_fleet({"workers": ["a", ""]})
+    assert any("empty worker" in d.message for d in diags)
+    diags = planlint.lint_fleet({"workers": ["a", "a"]})
+    assert any("duplicate worker" in d.message
+               and d.severity == "error" for d in diags)
+
+
+def test_pl014_lease_and_serve_rules():
+    diags = planlint.lint_fleet({"lease-s": 0})
+    assert ("PL014", "error") in _codes(diags)
+    diags = planlint.lint_fleet({"lease-s": -5})
+    assert ("PL014", "error") in _codes(diags)
+    diags = planlint.lint_fleet({"serve?": True, "device-slots": 0})
+    assert any("device slots" in d.message and d.severity == "error"
+               for d in diags)
+    # serve with a sane slot count is clean
+    assert planlint.lint_fleet({"serve?": True,
+                                "device-slots": 1}) == []
+
+
+def test_pl014_backend_and_lease_vs_time_limit():
+    diags = planlint.lint_fleet({"backends": ["tpu", "warp-drive"]})
+    assert any("warp-drive" in d.message and d.severity == "error"
+               for d in diags)
+    assert planlint.lint_fleet({"backends": ["tpu", "cpu"]}) == []
+    diags = planlint.lint_fleet({"lease-s": 10, "time-limit": 60})
+    assert any(d.code == "PL014" and d.severity == "warning"
+               and "outlives" in d.message for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# robust.leases
+
+
+def test_lease_table_stale_release_is_noop():
+    t = robust.LeaseTable()
+    l1 = t.grant("cell", "w1", 60)
+    assert l1.attempt == 1
+    l2 = t.grant("cell", "w2", 60)      # steal replaces
+    assert l2.attempt == 2
+    assert t.release(l1) is False       # stale holder can't release
+    assert t.holder("cell") == "w2"
+    assert t.release(l2) is True
+    assert t.holder("cell") is None
+    assert t.attempts("cell") == 2
+
+
+def test_lease_watchdog_fires_once_per_expiry():
+    t = robust.LeaseTable()
+    fired = []
+    wd = robust.LeaseWatchdog(t, fired.append, poll_s=0.02).start()
+    try:
+        t.grant("a", "w1", 0.01)
+        deadline = time.monotonic() + 5
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert [lease.unit for lease in fired] == ["a"]
+        time.sleep(0.1)                 # no re-fire: lease was removed
+        assert len(fired) == 1
+        assert t.holder("a") is None
+    finally:
+        wd.stop()
+
+
+def test_lease_watchdog_contains_callback_crash():
+    t = robust.LeaseTable()
+    seen = []
+
+    def boom(lease):
+        seen.append(lease.unit)
+        raise RuntimeError("buggy steal")
+
+    wd = robust.LeaseWatchdog(t, boom, poll_s=0.02).start()
+    try:
+        t.grant("a", "w", 0.01)
+        t.grant("b", "w", 0.01)
+        deadline = time.monotonic() + 5
+        while len(seen) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sorted(seen) == ["a", "b"]   # crash didn't kill the dog
+    finally:
+        wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# parse_workers
+
+
+def test_parse_workers_shapes():
+    ws = dispatch.parse_workers("local,local,name=local,db1:22")
+    assert [w.id for w in ws] == ["local", "local#2", "name", "db1:22"]
+    assert ws[0].kind == "local" and ws[2].kind == "local"
+    assert ws[3].kind == "ssh"
+    ws = dispatch.parse_workers(["h1"], ssh={"username": "u",
+                                             "port": 2222,
+                                             "password": "ignored"})
+    assert ws[0].conn_spec["username"] == "u"
+    assert ws[0].conn_spec["port"] == 2222
+    assert "password" not in ws[0].conn_spec
+
+
+# ---------------------------------------------------------------------------
+# dispatch: loopback fleet (real worker subprocesses)
+
+NOOP_OPTS = {"nodes": ["n1"], "concurrency": 1, "ssh": {"dummy?": True},
+             "time-limit": 1, "workload": "noop"}
+
+
+def _noop_cells(n=2):
+    return plan.expand({"axes": {"seed": list(range(n)),
+                                 "workload": ["noop"]}})
+
+
+def test_fleet_loopback_two_workers():
+    rep = dispatch.run_fleet(
+        _noop_cells(2), dispatch.parse_workers("local,local"),
+        campaign_id="fl", base_options=NOOP_OPTS, lease_s=120,
+        builder="jepsen_tpu.demo:demo_test")
+    assert rep["status"] == "complete"
+    assert rep["summary"]["outcomes"] == {"True": 2}
+    assert rep["mode"] == "fleet"
+    recs = store.latest_campaign_records("fl")
+    assert {r["worker"] for r in recs} <= {"local", "local#2"}
+    assert all(r.get("pid") not in (None, os.getpid()) for r in recs)
+    leases = [e for e in store.campaign_events("fl")
+              if e["event"] == "lease"]
+    assert sorted(e["cell"] for e in leases) == \
+        sorted(c["id"] for c in _noop_cells(2))
+    meta = CampaignJournal("fl").load_meta()
+    assert meta["mode"] == "fleet"
+    assert meta["workers"] == ["local", "local#2"]
+
+
+def test_fleet_worker_death_steals_cell(tmp_path):
+    """The acceptance test: kill -9 one worker mid-cell; the cell is
+    re-leased, re-run, and the journal shows exactly one terminal
+    record per cell."""
+    marker = str(tmp_path / "die-once")
+    cells = _noop_cells(2)
+    victim = cells[0]["id"]
+    cells[0]["params"]["die-once-marker"] = marker
+    rep = dispatch.run_fleet(
+        cells, dispatch.parse_workers("local,local"),
+        campaign_id="steal", base_options=NOOP_OPTS, lease_s=120,
+        builder="jepsen_tpu.demo:demo_test")
+    assert rep["status"] == "complete"
+    assert os.path.exists(marker)       # the SIGKILL really happened
+    recs = store.latest_campaign_records("steal")
+    assert {r["cell"]: r["outcome"] for r in recs} == {
+        c["id"]: True for c in cells}
+    stolen = [r for r in recs if r["cell"] == victim][0]
+    assert stolen["attempt"] == 2       # first lease died, second ran
+    evs = store.campaign_events("steal")
+    assert any(e["event"] == "lease-failed" and e["cell"] == victim
+               for e in evs)
+    assert len([e for e in evs if e["event"] == "lease"
+                and e["cell"] == victim]) == 2
+    # EXACTLY one terminal record per cell in the raw journal
+    terminal = [r for r in store.load_campaign_records("steal")
+                if not r.get("event")]
+    per_cell = {}
+    for r in terminal:
+        per_cell[r["cell"]] = per_cell.get(r["cell"], 0) + 1
+    assert all(v == 1 for v in per_cell.values()), per_cell
+
+
+def test_fleet_lease_budget_exhaustion(tmp_path):
+    """max_leases=1: the cell that kills its worker journals as
+    crashed instead of looping forever."""
+    marker = str(tmp_path / "die-once")
+    cells = _noop_cells(1)
+    cells[0]["params"]["die-once-marker"] = marker
+    rep = dispatch.run_fleet(
+        cells, dispatch.parse_workers("local"),
+        campaign_id="exh", base_options=NOOP_OPTS, lease_s=120,
+        max_leases=1, builder="jepsen_tpu.demo:demo_test")
+    recs = store.latest_campaign_records("exh")
+    assert recs[0]["outcome"] == "crashed"
+    assert "lease budget exhausted" in recs[0]["error"]
+    assert rep["summary"]["outcomes"] == {"crashed": 1}
+
+
+def test_fleet_resume_skips_terminal_cells():
+    cells = _noop_cells(2)
+    dispatch.run_fleet(cells, dispatch.parse_workers("local"),
+                       campaign_id="res", base_options=NOOP_OPTS,
+                       lease_s=120, builder="jepsen_tpu.demo:demo_test")
+    rep = dispatch.run_fleet(
+        cells, dispatch.parse_workers("local"),
+        campaign_id="res", resume=True, base_options=NOOP_OPTS,
+        lease_s=120, builder="jepsen_tpu.demo:demo_test")
+    assert rep["summary"]["skipped-resumed"] == 2
+    # no new leases were granted on resume
+    leases = [e for e in store.campaign_events("res")
+              if e["event"] == "lease"]
+    assert len(leases) == 2
+    with pytest.raises(dispatch.FleetError):
+        dispatch.run_fleet(cells, dispatch.parse_workers("local"),
+                           campaign_id="res", base_options=NOOP_OPTS)
+
+
+def test_fleet_dead_worker_probe_and_exhaustion():
+    ws = dispatch.parse_workers("local,local")
+    ws[1].probe = lambda timeout_s=30: "host unreachable"
+    rep = dispatch.run_fleet(
+        _noop_cells(2), ws, campaign_id="dead",
+        base_options=NOOP_OPTS, lease_s=120,
+        builder="jepsen_tpu.demo:demo_test")
+    # the healthy worker carried the whole campaign
+    assert rep["summary"]["outcomes"] == {"True": 2}
+    assert any(e["event"] == "worker-dead" and e["worker"] == "local#2"
+               for e in store.campaign_events("dead"))
+    # ALL workers dead -> abort, resumable, not "passed"
+    ws = dispatch.parse_workers("local")
+    ws[0].probe = lambda timeout_s=30: "down"
+    rep = dispatch.run_fleet(
+        _noop_cells(1), ws, campaign_id="alldead",
+        base_options=NOOP_OPTS, lease_s=120)
+    assert rep["status"] == "aborted"
+    assert rep["abort-reason"] == "workers-exhausted"
+
+
+def test_fleet_pl014_errors_refuse_the_run():
+    with pytest.raises(dispatch.FleetError):
+        dispatch.run_fleet(_noop_cells(1), [],
+                           campaign_id="nope", base_options=NOOP_OPTS)
+    with pytest.raises(dispatch.FleetError):
+        dispatch.run_fleet(_noop_cells(1),
+                           dispatch.parse_workers("local"),
+                           campaign_id="nope2", lease_s=0,
+                           base_options=NOOP_OPTS)
+
+
+def test_worker_parse_result():
+    assert fworker.parse_result("") is None
+    assert fworker.parse_result("noise\nJEPSEN-FLEET-RESULT: "
+                                '{"outcome": true}') == {
+        "outcome": True}
+    # searched from the end; torn json -> None, not a crash
+    assert fworker.parse_result("JEPSEN-FLEET-RESULT: {tor") is None
+    # marker-shaped lines whose JSON isn't a record are NOT results
+    assert fworker.parse_result("JEPSEN-FLEET-RESULT: [1, 2]") is None
+    assert fworker.parse_result("JEPSEN-FLEET-RESULT: null") is None
+    with pytest.raises(ValueError):
+        fworker.resolve_builder("no-colon")
+
+
+def test_worker_contains_builder_crash():
+    rec = fworker.run_cell_spec({
+        "cell": "x", "campaign": "c",
+        "builder": "jepsen_tpu.demo:does_not_exist",
+        "store-dir": store.base_dir})
+    assert rec["outcome"] == "crashed"
+    assert "does_not_exist" in rec["error"]
+
+
+# ---------------------------------------------------------------------------
+# service: /api logic without a socket
+
+VALID_HIST = [
+    {"type": "invoke", "process": 0, "f": "write", "value": 1},
+    {"type": "ok", "process": 0, "f": "write", "value": 1},
+    {"type": "invoke", "process": 1, "f": "read", "value": None},
+    {"type": "ok", "process": 1, "f": "read", "value": 1},
+]
+BAD_HIST = [
+    {"type": "invoke", "process": 0, "f": "write", "value": 1},
+    {"type": "ok", "process": 0, "f": "write", "value": 1},
+    {"type": "invoke", "process": 1, "f": "read", "value": None},
+    {"type": "ok", "process": 1, "f": "read", "value": 99},
+]
+
+
+def test_api_check_matches_offline_checker():
+    r = service.check_history({"history": VALID_HIST,
+                               "model": "register", "engine": "wgl"})
+    assert r["valid"] is True and r["engine"] == "wgl"
+    r = service.check_history({"history": BAD_HIST,
+                               "model": "register", "engine": "wgl"})
+    assert r["valid"] is False
+    # the linear engine agrees
+    r = service.check_history({"history": BAD_HIST,
+                               "model": "register",
+                               "engine": "linear"})
+    assert r["valid"] is False
+
+
+def test_api_check_keyed_histories():
+    hist = []
+    for k, bad in (("a", False), ("b", True)):
+        hist += [
+            {"type": "invoke", "process": 0, "f": "write",
+             "value": [k, 1]},
+            {"type": "ok", "process": 0, "f": "write", "value": [k, 1]},
+            {"type": "invoke", "process": 1, "f": "read",
+             "value": [k, None]},
+            {"type": "ok", "process": 1, "f": "read",
+             "value": [k, 99 if bad else 1]},
+        ]
+    r = service.check_history({"history": hist, "model": "register",
+                               "engine": "wgl", "keyed": True})
+    assert r["valid"] is False
+    assert r["keys"]["a"]["valid"] is True
+    assert r["keys"]["b"]["valid"] is False
+
+
+def test_api_check_rejections():
+    with pytest.raises(service.ApiError) as e:
+        service.check_history({"history": VALID_HIST,
+                               "model": "no-such-model"})
+    assert e.value.status == 400
+    with pytest.raises(service.ApiError) as e:
+        service.check_history({"history": VALID_HIST,
+                               "engine": "warp"})
+    assert e.value.status == 400
+    with pytest.raises(service.ApiError) as e:
+        service.check_history({"history": "nope"})
+    assert e.value.status == 400
+    # histlint catches the malformed history and names the code
+    with pytest.raises(service.ApiError) as e:
+        service.check_history({
+            "history": [{"type": "ok", "process": 0, "f": "read"}],
+            "model": "register"})
+    assert e.value.status == 400
+    assert any("HL" in d["code"]
+               for d in e.value.payload["diagnostics"])
+    with pytest.raises(service.ApiError) as e:
+        service.check_history({"history": VALID_HIST,
+                               "timeout-s": -1})
+    assert e.value.status == 400
+
+
+def test_api_check_bounds_history_size(monkeypatch):
+    monkeypatch.setattr(service, "MAX_CHECK_OPS", 2)
+    with pytest.raises(service.ApiError) as e:
+        service.check_history({"history": VALID_HIST})
+    assert e.value.status == 413
+
+
+def test_api_campaign_submit_poll_and_shutdown():
+    cid, meta = service.submit_campaign(
+        {"axes": {"workload": ["noop"], "seed": [0, 1]},
+         "options": {"time-limit": 1}, "parallel": 2, "id": "api1"})
+    assert cid == "api1"
+    assert meta["status-url"] == "/api/campaigns/api1"
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        st = service.campaign_status("api1")
+        if st["status"] in ("complete", "aborted"):
+            break
+        time.sleep(0.2)
+    assert st["status"] == "complete"
+    assert st["outcomes"] == {"True": 2}
+    with pytest.raises(service.ApiError) as e:
+        service.submit_campaign({"axes": {"workload": ["noop"]},
+                                 "id": "api1"})
+    assert e.value.status == 409
+    with pytest.raises(service.ApiError) as e:
+        service.campaign_status("nope")
+    assert e.value.status == 404
+    with pytest.raises(service.ApiError) as e:
+        service.submit_campaign({"axes": {}})
+    assert e.value.status == 400
+
+
+def test_api_campaign_id_path_traversal_refused():
+    with pytest.raises(service.ApiError) as e:
+        service.submit_campaign({"axes": {"workload": ["noop"]},
+                                 "id": "../../../tmp/evil"})
+    assert e.value.status == 400
+    for cid in ("../x", "a/b", "..", ".hidden", ""):
+        with pytest.raises(service.ApiError) as e:
+            service.campaign_status(cid)
+        assert e.value.status == 400
+    # nothing escaped the store
+    assert not os.path.exists(os.path.join(store.base_dir, "..",
+                                           "campaigns"))
+
+
+def test_api_campaign_protected_options_and_bad_ints():
+    with pytest.raises(service.ApiError) as e:
+        service.submit_campaign({"axes": {"workload": ["noop"]},
+                                 "parallel": "two", "id": "badint"})
+    assert e.value.status == 400
+    with pytest.raises(service.ApiError) as e:
+        service.submit_campaign({"axes": {"workload": ["noop"]},
+                                 "device-slots": 0, "id": "badint2"})
+    assert e.value.status == 400
+    # a payload re-enabling real SSH / pointing at real hosts is
+    # neutered: the campaign still runs on the dummy remote and
+    # completes instead of dialing out
+    cid, _meta = service.submit_campaign(
+        {"axes": {"workload": ["noop"]},
+         "options": {"ssh": {"dummy?": False},
+                     "nodes": ["evil-host"], "time-limit": 1},
+         "id": "neutered"})
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = service.campaign_status(cid)
+        if st["status"] in ("complete", "aborted"):
+            break
+        time.sleep(0.2)
+    assert st["status"] == "complete"
+    assert st["outcomes"] == {"True": 1}
+
+
+def test_api_check_whole_request_timeout_budget():
+    r = service.check_history({"history": BAD_HIST,
+                               "model": "register", "engine": "wgl",
+                               "timeout-s": 1e-9})
+    assert r["valid"] == "unknown"
+    assert "budget exhausted" in r["error"]
+
+
+def test_api_campaign_shutdown_aborts_gracefully():
+    service.submit_campaign(
+        {"axes": {"workload": ["noop"], "seed": list(range(50))},
+         "options": {"time-limit": 30}, "id": "api-abort"})
+    # let it actually start, then honor the shared latch
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if CampaignJournal("api-abort").load_meta():
+            break
+        time.sleep(0.1)
+    service.shutdown(join_s=60)
+    meta = CampaignJournal("api-abort").load_meta()
+    assert meta["status"] == "aborted"
+    assert service.latch().is_set()
+
+
+# ---------------------------------------------------------------------------
+# web handler: transport hardening over a real socket
+
+
+@pytest.fixture()
+def api_server():
+    server = web.serve({"ip": "127.0.0.1", "port": 0})
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def _post(base, path, data, headers=None):
+    req = urllib.request.Request(
+        base + path, data=data,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_web_api_check_roundtrip(api_server):
+    s, r = _post(api_server, "/api/check",
+                 json.dumps({"history": BAD_HIST, "model": "register",
+                             "engine": "wgl"}).encode())
+    assert s == 200 and r["valid"] is False
+
+
+def test_web_api_oversized_body_gets_413_not_oom(api_server):
+    """The regression test: an oversized declared body must be refused
+    BEFORE any read. Only one byte is ever sent -- if the handler
+    tried to read Content-Length bytes it would block and time out
+    instead of answering 413 instantly."""
+    s, r = _post(api_server, "/api/check", b"x",
+                 headers={"Content-Length":
+                          str(service.MAX_BODY_BYTES + 1)})
+    assert s == 413
+    assert "exceeds" in r["error"]
+
+
+def test_web_api_json_errors(api_server):
+    s, r = _post(api_server, "/api/nope", b"{}")
+    assert s == 404 and "error" in r
+    s, r = _post(api_server, "/api/check", b"{not json")
+    assert s == 400 and "error" in r
+    # GET on a POST-only route: 405, JSON
+    try:
+        urllib.request.urlopen(api_server + "/api/check", timeout=30)
+        raise AssertionError("expected 405")
+    except urllib.error.HTTPError as e:
+        assert e.code == 405
+        assert "error" in json.loads(e.read())
+    # missing Content-Length: 411 (urllib always sends it, so go raw)
+    import http.client
+    host = api_server[len("http://"):]
+    conn = http.client.HTTPConnection(host, timeout=30)
+    conn.putrequest("POST", "/api/check", skip_accept_encoding=True)
+    conn.endheaders()
+    resp = conn.getresponse()
+    assert resp.status == 411
+    conn.close()
+    # non-api POSTs stay plain HTML 404
+    req = urllib.request.Request(api_server + "/files/x", data=b"{}")
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+        assert b"<h1>" in e.read()
+
+
+def test_web_api_campaign_listing(api_server):
+    CampaignJournal("listed").write_meta({"status": "complete"})
+    with urllib.request.urlopen(api_server + "/api/campaigns",
+                                timeout=30) as r:
+        assert json.loads(r.read())["campaigns"] == ["listed"]
+    with urllib.request.urlopen(
+            api_server + "/api/campaigns/listed", timeout=30) as r:
+        body = json.loads(r.read())
+    assert body["status"] == "complete"
+
+
+# ---------------------------------------------------------------------------
+# backends: failover tiering
+
+
+def test_failover_ladder_caching_and_floor():
+    calls = []
+
+    def fake_probe(tier, timeout_s=None):
+        calls.append(tier)
+        return None if tier == "gpu" else "down"
+
+    f = fbackends.Failover(ladder=("tpu", "gpu", "cpu"),
+                           probe_fn=fake_probe)
+    assert f.choose() == "gpu"
+    assert f.choose() == "gpu"
+    assert calls == ["tpu", "gpu"]      # cached: one probe per tier
+    down = fbackends.Failover(
+        probe_fn=lambda t, timeout_s=None: "down")
+    assert down.choose() == "cpu"       # the unconditional floor
+    with pytest.raises(ValueError):
+        fbackends.Failover(ladder=("warp",))
+    with pytest.raises(ValueError):
+        fbackends.Failover(ladder=())
+    assert fbackends.as_failover("gpu,cpu").ladder == ["gpu", "cpu"]
+    assert fbackends.as_failover(f) is f
+    assert fbackends.as_failover(True).ladder == list(
+        fbackends.DEFAULT_LADDER)
+
+
+def test_backend_apply_degrades_linearizable_gates():
+    from jepsen_tpu import checker as cc
+    from jepsen_tpu.checker import checkers as cks
+    from jepsen_tpu.models import register_spec
+    lin = cks.Linearizable(register_spec, "jax-wgl")
+    test = {"checker": cc.compose({"w": lin, "stats": cks.stats()})}
+    fbackends.apply(test, "cpu")
+    assert lin.algorithm == "linear"
+    assert test["backend"] == "cpu"
+    # a healthy tier leaves the checker's own choice alone
+    lin2 = cks.Linearizable(register_spec, "jax-wgl")
+    fbackends.apply({"checker": lin2}, "tpu")
+    assert lin2.algorithm == "jax-wgl"
+    assert fbackends.tier_env("cpu") == {"JAX_PLATFORMS": "cpu"}
+
+
+def test_cpu_probe_is_healthy_here():
+    assert fbackends.probe("cpu") is None
+
+
+def test_scheduler_applies_backend_tier():
+    from jepsen_tpu import checker as cc
+    from jepsen_tpu import client as jc
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import tests as tst
+    from jepsen_tpu.checker import checkers as cks
+    from jepsen_tpu.models import register_spec
+
+    class OkClient(jc.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            return dict(op, type="ok")
+
+    lin = cks.Linearizable(register_spec, "jax-wgl")
+    t = tst.noop_test()
+    t.update({"ssh": {"dummy?": True}, "obs?": False, "name": "bk",
+              "nodes": ["n1"], "concurrency": 1, "client": OkClient(),
+              "checker": lin,
+              "generator": gen.clients(gen.limit(
+                  3, gen.repeat({"f": "read"})))})
+    f = fbackends.Failover(ladder=("tpu", "cpu"),
+                           probe_fn=lambda t_, timeout_s=None: "down")
+    rep = scheduler.run_cells([{"id": "a", "test": t}],
+                              campaign_id="bk", backends=f)
+    rec = store.latest_campaign_records("bk")[0]
+    assert rec["backend"] == "cpu"
+    assert lin.algorithm == "linear"    # the gate really was degraded
+    assert rep["summary"]["outcomes"] == {"True": 1}

@@ -21,6 +21,10 @@ Rungs (BASELINE.md north-star table):
   8. fleet compile-ledger reuse: the same 2x2 matrix run twice in two
      SEPARATE scheduler processes; the warm process must report
      persistent-ledger hits > 0, with cold-vs-warm wall clock recorded
+  9. search-plan reduction: the same quiescent 4-key register history
+     checked with the searchplan analyzer on and off; the detail
+     records segment count, config-count estimate vs actual, wall
+     clock for both paths, and the planner's own cost fraction
 
 The baseline is the sequential CPU WGL oracle (our knossos stand-in,
 checker/wgl.py) with a 60 s / config-capped budget per history.
@@ -233,6 +237,114 @@ def _fleet_reuse_rung(time_limit_s=3, budget_s=600):
         out["warm_speedup"] = round(
             out["cold"]["wall_s"] / out["warm"]["wall_s"], 2) \
             if out["warm"]["wall_s"] else None
+        return out
+    except Exception as exc:  # noqa: BLE001 - numbers, not crashes
+        return {"error": repr(exc)[:300]}
+
+
+def _searchplan_rung(keys=4, bursts=6):
+    """Search-plan reduction (jepsen_tpu.analysis.searchplan): the
+    same quiescent multi-key cas-register batch checked with planning
+    on and off, reporting
+
+      segments                sub-searches the planner produced
+      est_configs             planner's estimate, planned vs unplanned
+      configs_explored        ACTUAL configs, planned vs unplanned
+      wall_s                  device wall, planned vs unplanned
+      planner_s / frac        the analyzer's own cost and its share of
+                              the planned path's end-to-end time
+
+    Each key is `bursts` concurrent write||write bursts separated by
+    sealed quiescent writes, with one crashed (:info) read per burst
+    and a STALE final read: the history is invalid, so both paths run
+    a full exhaustion proof — and the flat one must carry every
+    subset of the forever-open crashed reads (they are optional to
+    linearize at every config, ~2^bursts distinct configs), while the
+    planner elides them as search-dead and proves each tiny segment
+    in isolation. The stale value is one actually written earlier, so
+    the state-abstraction fast path can't shortcut either side.
+    Self-contained and never fatal: a planner regression must show up
+    as numbers (or an error field), not break the throughput bench."""
+    try:
+        from jepsen_tpu.analysis import searchplan
+        from jepsen_tpu.models import model_spec
+        from jepsen_tpu.parallel import check_batch_encoded
+        spec = model_spec("cas-register")
+
+        def key_hist():
+            evs = []
+            i = 0
+
+            def ev(t, p, f, v):
+                nonlocal i
+                evs.append({"type": t, "process": p, "f": f,
+                            "value": v, "index": i})
+                i += 1
+
+            for j in range(bursts):
+                x = j * 10
+                ev("invoke", 0, "write", x)
+                ev("invoke", 1, "write", x + 1)
+                ev("ok", 0, "write", x)
+                ev("ok", 1, "write", x + 1)
+                ev("invoke", 100 + j, "read", None)  # client times out:
+                ev("info", 100 + j, "read", None)    # open forever
+                ev("invoke", 0, "write", x + 5)   # sealing quiescent
+                ev("ok", 0, "write", x + 5)       # write closes burst
+            ev("invoke", 2, "read", None)
+            ev("ok", 2, "read", 0)                # stale read: invalid
+            return evs
+
+        hists = [key_hist() for _ in range(keys)]
+        out = {"keys": keys, "ops_per_key": len(hists[0]) // 2}
+
+        # unplanned: today's default per-key batch
+        pairs_off = [spec.encode(hv) for hv in hists]
+        t0 = time.monotonic()
+        r_off = check_batch_encoded(spec, pairs_off)
+        out["wall_s_unplanned"] = round(time.monotonic() - t0, 3)
+
+        # planned: segment each key at sealed quiescent cuts, one batch
+        t0 = time.monotonic()
+        all_segs = []
+        spans = []
+        est_planned = 0
+        for hv in hists:
+            segs, _info = searchplan.segment_events(spec, hv,
+                                                    min_segment=1)
+            spans.append((len(all_segs), len(segs)))
+            all_segs += segs
+            est_planned += sum(s.est_configs for s in segs)
+        planner_s = time.monotonic() - t0
+        pairs_on = [spec.encode(s.events) for s in all_segs]
+        t0 = time.monotonic()
+        r_on = check_batch_encoded(spec, pairs_on)
+        wall_on = time.monotonic() - t0
+        out.update({
+            "segments": len(all_segs),
+            "est_configs": {
+                "planned": est_planned,
+                "unplanned": sum(searchplan.estimate_configs(hv)
+                                 for hv in hists)},
+            "configs_explored": {
+                "planned": sum(int(r.get("configs_explored") or 0)
+                               for r in r_on),
+                "unplanned": sum(int(r.get("configs_explored") or 0)
+                                 for r in r_off)},
+            "wall_s_planned": round(wall_on, 3),
+            "planner_s": round(planner_s, 4),
+            "planner_frac": round(planner_s / max(1e-9,
+                                                  planner_s + wall_on),
+                                  4),
+            "verdicts_equal": (
+                [r.get("valid") for r in r_off]
+                == [searchplan.merge_segment_results(
+                    r_on[s:s + c]).get("valid")
+                    for s, c in spans]),
+        })
+        out["reduction"] = round(
+            out["configs_explored"]["unplanned"]
+            / max(1, out["configs_explored"]["planned"]), 2)
         return out
     except Exception as exc:  # noqa: BLE001 - numbers, not crashes
         return {"error": repr(exc)[:300]}
@@ -714,6 +826,10 @@ def _bench_body(_obs_reg):
     # SEPARATE scheduler processes; warm must report ledger hits > 0
     # (runs on CPU in subprocesses -- see the rung's docstring)
     rungs["8-fleet-reuse"] = _fleet_reuse_rung()
+
+    # search-plan rung: quiescent-cut slicing must beat the flat batch
+    # on explored configs, with the planner itself in the noise
+    rungs["9-searchplan"] = _searchplan_rung()
 
     # CPU oracles race in parallel subprocesses AFTER all device
     # measurements (their CPU load would pollute the device numbers);

@@ -14,13 +14,21 @@ and two renderers (`render_text` / `to_json`):
   ``--lint`` on the CLI.
 * **jaxlint** -- jaxpr hazard analysis of jitted WGL step functions:
   recompilation hazards, host syncs, int32 index-width overflow.
+* **searchplan** -- P-compositionality search planning over histories:
+  partition-predicate discovery (per-key, per-value, crash-isolated
+  segments) plus sealed quiescent-cut slicing that rewrites one device
+  search into many small ones. Reported once per test by
+  ``checker.core.plan_history`` (opt out ``test["searchplan?"] =
+  False``); consumed by the Linearizable/independent checkers, the
+  streaming monitor, and the fleet check service.
 * **codelint** -- AST thread-safety lint over the framework's own
   source, driven by ``tools/lint.py``.
 
 See doc/analysis.md for the code catalogue.
 """
 
-from . import codelint, histlint, jaxlint, planlint  # noqa: F401
+from . import (codelint, histlint, jaxlint, planlint,  # noqa: F401
+               searchplan)
 from .diagnostics import (Diagnostic, ERROR, INFO,  # noqa: F401
                           SEVERITIES, WARNING, diag, errors,
                           max_severity, render_text, run_analyzer,
@@ -33,7 +41,7 @@ __all__ = [
     "Diagnostic", "ERROR", "WARNING", "INFO", "SEVERITIES", "diag",
     "errors", "warnings", "max_severity", "severity_counts",
     "render_text", "to_json", "run_analyzer",
-    "histlint", "planlint", "jaxlint", "codelint",
+    "histlint", "planlint", "jaxlint", "codelint", "searchplan",
     "lint_history", "lint_encoded", "lint_test_history",
     "lint_plan", "preflight", "PlanLintError",
 ]

@@ -27,6 +27,10 @@ Codes:
   JX005 warning  encoded history within 2x of the int32 index ceiling
   JX006 warning  dtype-widening op (int64/float64) in the jaxpr: the
                  search is an int32 kernel; x64 doubles HBM traffic
+  JX007 warning  sub-search shape proliferation: a SearchPlan whose
+                 segments pad to more than MAX_PLAN_SHAPES distinct
+                 (n, bucket) shapes defeats compile reuse — every
+                 distinct bucket is another XLA compile
 
 Everything here imports jax lazily so the analyzer surface can load in
 jax-free tooling contexts.
@@ -40,6 +44,7 @@ from .diagnostics import ERROR, WARNING, diag
 
 __all__ = ["lint_fn", "lint_jaxpr", "lint_model_spec",
            "lint_history_size", "lint_search_plan",
+           "lint_searchplan_shapes", "MAX_PLAN_SHAPES",
            "INT32_CELL_LIMIT", "HOST_CALLBACK_PRIMITIVES"]
 
 #: primitives that round-trip to the host (an implicit sync when they
@@ -54,6 +59,11 @@ INT32_CELL_LIMIT = 2 ** 31
 
 #: captured constants larger than this many elements are flagged JX002
 CONST_ELEMENT_LIMIT = 1024
+
+#: distinct padded (n, bucket) shapes a SearchPlan may spread its
+#: sub-searches over before JX007 flags it (each extra bucket is
+#: another compile the ledger can't amortize)
+MAX_PLAN_SHAPES = 4
 
 _WIDE_DTYPES = ("int64", "uint64", "float64")
 
@@ -215,6 +225,31 @@ def lint_history_size(n, arg_width=1, keys=1, where="encoded-history"):
             where,
             "plan for key sharding before the workload grows"))
     return diags
+
+
+def lint_searchplan_shapes(op_counts, max_shapes=MAX_PLAN_SHAPES,
+                           where="search-plan"):
+    """JX007: how many distinct padded op-count buckets a SearchPlan's
+    sub-searches land in. Buckets mirror the engines' padding
+    (``jax_wgl._bucket`` over the campaign-tunable ``_n_floor``), so
+    the count is exactly the number of compiled search shapes the
+    plan will demand along the n axis."""
+    from ..checker import jax_wgl
+    floor = jax_wgl._n_floor()
+    buckets = sorted({jax_wgl._bucket(max(1, int(n)), floor)
+                      for n in op_counts if int(n) > 0})
+    if len(buckets) <= max_shapes:
+        return []
+    shown = str(buckets[:8]) + ("..." if len(buckets) > 8 else "")
+    return [diag(
+        "JX007", WARNING,
+        f"{len(op_counts)} sub-search(es) pad to {len(buckets)} "
+        f"distinct op-count buckets {shown}: more than {max_shapes} "
+        "shapes defeats compile reuse",
+        where,
+        "raise the shared op-count bucket floor "
+        "(campaign.compile_cache.set_n_floor / bucket_floor) so "
+        "segments land in one padded shape")]
 
 
 def lint_search_plan(n, S, C=None, keys=1, arg_width=1,

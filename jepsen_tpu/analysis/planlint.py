@@ -42,6 +42,15 @@ Codes:
                  shorter than the cell time-limit, so every healthy
                  cell outlives its own lease and is pointlessly stolen
                  (warning)
+  PL015 mixed    searchplan preflight: an unknown partition predicate
+                 name in searchplan-partitions (error — the planner
+                 would skip it at run time, silently losing the
+                 reduction); searchplan explicitly enabled but the
+                 checker tree has no model with f_codes to plan for,
+                 a non-positive searchplan-min-segment, or the
+                 monitor armed with quiescent-cut carry disabled
+                 (crash-free monitored runs then re-check O(prefix),
+                 not O(window)) — warnings
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -60,7 +69,8 @@ from .histlint import model_op_set
 logger = logging.getLogger(__name__)
 
 __all__ = ["lint_plan", "lint_campaign", "lint_fleet", "preflight",
-           "PlanLintError", "FATAL_CODES", "monitor_diags"]
+           "PlanLintError", "FATAL_CODES", "monitor_diags",
+           "searchplan_diags"]
 
 #: error codes certain enough to abort the run before node contact
 FATAL_CODES = {"PL001", "PL003", "PL004", "PL005", "PL006"}
@@ -221,6 +231,89 @@ def lint_plan(test):
 
     # -- streaming-monitor knobs (jepsen_tpu.monitor) ------------------
     diags += monitor_diags(test)
+
+    # -- search-plan knobs (jepsen_tpu.analysis.searchplan) ------------
+    diags += searchplan_diags(test)
+    return diags
+
+
+def searchplan_diags(test):
+    """The PL015 rules over a test map's (or option map's) searchplan
+    wiring. Works on plain option dicts too — the fleet dispatcher
+    runs it over base options, where checker-based checks just
+    skip."""
+    diags = []
+    if not isinstance(test, dict):
+        return diags
+    from .searchplan import PREDICATES
+    names = test.get("searchplan-partitions")
+    if names is not None:
+        unknown = [str(n) for n in names if str(n) not in PREDICATES]
+        if unknown:
+            diags.append(diag(
+                "PL015", ERROR,
+                f"unknown partition predicate name(s) {unknown}: known "
+                f"predicates are {list(PREDICATES)}",
+                "plan.searchplan-partitions",
+                "the planner skips unknown names at run time, silently "
+                "losing the reduction"))
+    ms = test.get("searchplan-min-segment")
+    if ms is not None and (not isinstance(ms, int)
+                           or isinstance(ms, bool) or ms <= 0):
+        diags.append(diag(
+            "PL015", WARNING,
+            f"searchplan-min-segment should be a positive integer, "
+            f"got {ms!r}: the default applies instead",
+            "plan.searchplan-min-segment"))
+    explicit_on = test.get("searchplan?") is True \
+        or bool(test.get("searchplan-partitions"))
+    if explicit_on and test.get("checker") is not None:
+        plannable = True
+        try:
+            from ..monitor.core import find_linearizable
+            lin, _keyed = find_linearizable(test.get("checker"))
+            plannable = lin is not None and bool(
+                getattr(getattr(lin, "spec", None), "f_codes", None))
+        except Exception:  # noqa: BLE001 - reflection is best-effort
+            plannable = True
+        if not plannable:
+            diags.append(diag(
+                "PL015", WARNING,
+                "searchplan explicitly enabled but the checker tree "
+                "has no linearizable gate with a model f_codes map: "
+                "there is nothing to plan, the knob is a no-op",
+                "plan.searchplan",
+                "searchplan plans histories checked by "
+                "checkers.linearizable (directly, composed, or under "
+                "independent)"))
+    if test.get("monitor"):
+        from ..monitor import config as monitor_config
+        from .searchplan import segments_enabled
+        cfg = monitor_config(test) or {}
+        carry_off = cfg.get("quiescent-carry?") is False \
+            or not segments_enabled(test)
+        if carry_off:
+            diags.append(diag(
+                "PL015", WARNING,
+                "the monitor is armed without quiescent-cut carry: "
+                "crash-free monitored runs re-check the ever-growing "
+                "prefix (O(prefix) per chunk) instead of the open "
+                "window",
+                "plan.monitor",
+                "drop {'quiescent-carry?': False} / re-enable "
+                "searchplan unless you are debugging the carry "
+                "itself"))
+        if cfg.get("skip-offline?") and not carry_off:
+            diags.append(diag(
+                "PL015", WARNING,
+                "skip-offline? records the monitor verdict as final "
+                "while quiescent-cut carry truncates what the monitor "
+                "re-checks: the offline re-check that normally "
+                "backstops the carry is gone, so the verdict rests on "
+                "the stream-cut rule alone",
+                "plan.monitor",
+                "drop 'skip-offline?' (keep the offline re-check) or "
+                "set {'quiescent-carry?': False} alongside it"))
     return diags
 
 

@@ -126,39 +126,49 @@ class Linearizable(Checker):
     def check(self, test, hist, opts=None):
         from . import jax_wgl, linear, wgl
         client_hist = self.prepare_history(h.client_ops(hist))
-        e, init_state = self.spec.encode(client_hist)
         algo = self.algorithm
-        if algo == "wgl":
-            a = wgl.check_encoded(self.spec, e, init_state)
-        elif algo == "linear":
-            a = linear.check_encoded(self.spec, e, init_state)
-        elif algo == "jax-wgl":
-            opts = dict(self.engine_opts)
-            mesh = opts.pop("mesh", None)
-            if mesh is not None:
-                # one SINGLE-key search sharded across the mesh
-                # (parallel/searchshard.py); the multi-key batched
-                # path takes mesh via independent's engine_opts.
-                # Forward only the options the sharded engine
-                # supports; warn-drop the rest rather than crash a
-                # whole check over e.g. a checkpoint path
-                from ..parallel import check_encoded_sharded
-                keep = {"max_configs", "frontier_width", "stack_size",
-                        "table_size", "timeout_s", "chunk_iters",
-                        "steal", "rollout_seeds"}
-                dropped = sorted(set(opts) - keep)
-                if dropped:
-                    logger.warning(
-                        "engine_opts %s are not supported by the "
-                        "mesh-sharded search; ignoring", dropped)
-                a = check_encoded_sharded(
-                    self.spec, e, init_state, mesh,
-                    **{k: v for k, v in opts.items() if k in keep})
+        # search planning (analysis/searchplan.py): sealed quiescent
+        # cuts slice the history into independent segments routed as
+        # ONE batch through parallel/keyshard (same _n_floor buckets,
+        # so the compile ledger still hits). Default on; opt out with
+        # test["searchplan?"] = False. None = no reduction / planning
+        # failed -> the unplanned search below runs as always.
+        a = None
+        if algo == "jax-wgl" and "mesh" not in self.engine_opts:
+            a = self._check_planned(test, client_hist)
+        if a is None:
+            e, init_state = self.spec.encode(client_hist)
+            if algo == "wgl":
+                a = wgl.check_encoded(self.spec, e, init_state)
+            elif algo == "linear":
+                a = linear.check_encoded(self.spec, e, init_state)
+            elif algo == "jax-wgl":
+                opts = dict(self.engine_opts)
+                mesh = opts.pop("mesh", None)
+                if mesh is not None:
+                    # one SINGLE-key search sharded across the mesh
+                    # (parallel/searchshard.py); the multi-key batched
+                    # path takes mesh via independent's engine_opts.
+                    # Forward only the options the sharded engine
+                    # supports; warn-drop the rest rather than crash a
+                    # whole check over e.g. a checkpoint path
+                    from ..parallel import check_encoded_sharded
+                    keep = {"max_configs", "frontier_width",
+                            "stack_size", "table_size", "timeout_s",
+                            "chunk_iters", "steal", "rollout_seeds"}
+                    dropped = sorted(set(opts) - keep)
+                    if dropped:
+                        logger.warning(
+                            "engine_opts %s are not supported by the "
+                            "mesh-sharded search; ignoring", dropped)
+                    a = check_encoded_sharded(
+                        self.spec, e, init_state, mesh,
+                        **{k: v for k, v in opts.items() if k in keep})
+                else:
+                    a = jax_wgl.check_encoded(self.spec, e, init_state,
+                                              **opts)
             else:
-                a = jax_wgl.check_encoded(self.spec, e, init_state,
-                                          **opts)
-        else:
-            a = self._competition(e, init_state)
+                a = self._competition(e, init_state)
         # truncate heavyweight fields (checker.clj:213-216: "writing
         # these can take *hours*"): at most 10 paths / 10 configs
         if "final_paths" in a:
@@ -176,6 +186,69 @@ class Linearizable(Checker):
                                exc_info=True)
         a["valid?"] = a["valid"]
         return a
+
+    #: engine_opts forwarded to the planned batch path — everything
+    #: check_batch_encoded supports, including checkpoint/resume (its
+    #: fingerprint covers the per-segment inputs, so a rerun of the
+    #: same plan resumes). The rest are single-search-only
+    #: (confirm/rollout_kernel/rollout_depth); mesh is excluded up
+    #: front in check().
+    _PLANNED_OPTS = frozenset({"max_configs", "chunk_iters", "timeout_s",
+                               "frontier_width", "stack_size",
+                               "table_size", "rollout_seeds",
+                               "checkpoint", "checkpoint_every_s"})
+
+    def _check_planned(self, test, client_hist):
+        """Consult the search plan for this (already init-op-prepared)
+        client history: when sealed quiescent cuts slice it into >= 2
+        segments, run them as one batched device call and merge.
+        Returns None when planning is off, yields no reduction, or
+        fails -- the caller then runs the unplanned search, so a
+        planner bug can never change a verdict."""
+        if not isinstance(test, dict):
+            return None
+        from ..analysis import searchplan
+        # this path's only reduction IS quiescent-cut segmentation, so
+        # it honors the predicate list, not just the on/off knob
+        if not searchplan.segments_enabled(test):
+            return None
+        unsupported = set(self.engine_opts) - self._PLANNED_OPTS
+        if "confirm" in unsupported:
+            # oracle confirmation changes the result contract
+            # (result["confirmed"]); the flat search honors it, so
+            # planning steps aside rather than silently dropping it
+            return None
+        if unsupported:
+            logger.warning(
+                "engine_opts %s are not supported by the planned "
+                "batch search; ignoring", sorted(unsupported))
+        try:
+            import time as _time
+            t0 = _time.monotonic()
+            segs, info = searchplan.plan_segments(
+                self.spec, client_hist, searchplan.min_segment(test))
+            if len(segs) < 2:
+                return None
+            # plan_s = the analyzer's own cost (matching the
+            # independent path's measurement); encoding is charged to
+            # the search like it is on the unplanned path
+            plan_s = _time.monotonic() - t0
+            from ..parallel import check_batch_encoded
+            pairs = [self.spec.encode(s.events) for s in segs]
+            eopts = {k: v for k, v in self.engine_opts.items()
+                     if k in self._PLANNED_OPTS}
+            results = check_batch_encoded(self.spec, pairs, **eopts)
+            merged = searchplan.merge_segment_results(results, info,
+                                                      plan_s)
+            if obs.enabled():
+                obs.inc("checker.planned_checks",
+                        valid=str(merged.get("valid")))
+                obs.observe("checker.plan_s", plan_s)
+            return merged
+        except Exception:  # noqa: BLE001 - fall back to the flat search
+            logger.warning("planned search failed; falling back to the "
+                           "unplanned path", exc_info=True)
+            return None
 
     def _competition(self, e, init_state):
         """Race the sequential oracle against the device engine; the first

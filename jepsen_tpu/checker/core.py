@@ -17,7 +17,7 @@ from ..util import real_pmap
 
 __all__ = ["Checker", "check", "check_safe", "compose", "concurrency_limit",
            "noop", "unbridled_optimism", "merge_valid", "valid_prio",
-           "lint_history"]
+           "lint_history", "plan_history"]
 
 logger = logging.getLogger(__name__)
 
@@ -116,9 +116,50 @@ def lint_history(test, hist):
         logger.warning("history lint crashed", exc_info=True)
 
 
+def plan_history(test, hist):
+    """Run the search planner over ``hist`` once per test map, next to
+    histlint: the SearchPlan's SP/JX007 diagnostics land in
+    ``test["analysis"]["searchplan"]`` (persisted as analysis.json)
+    with the plan summary alongside. The *executing* checkers
+    (Linearizable, independent's batched path) re-derive their own
+    segments — this hook is the report of record, and like histlint
+    it is contained: a planner bug must never change a verdict. Opt
+    out per test with ``test["searchplan?"] = False`` (or
+    ``test["analysis?"] = False`` for all analyzers)."""
+    if not isinstance(test, dict) or not test.get("analysis?", True):
+        return
+    from ..analysis import searchplan
+    if not searchplan.enabled(test):
+        return
+    with _lint_lock:
+        if test.get("searchplan-done?"):
+            return
+        test["searchplan-done?"] = True
+    try:
+        from .. import analysis
+        holder = {}
+
+        def build():
+            plan = searchplan.build_plan(test, hist)
+            if plan is None:
+                return []
+            holder["summary"] = plan.summary()
+            return plan.diagnostics
+
+        diags = analysis.run_analyzer("searchplan", build)
+        summary = holder.get("summary")
+        if summary is not None:
+            report = analysis.to_json(diags)
+            report["summary"] = summary
+            test.setdefault("analysis", {})["searchplan"] = report
+    except Exception:  # noqa: BLE001 - telemetry, never verdict-bearing
+        logger.warning("search planning crashed", exc_info=True)
+
+
 def check(checker, test, hist, opts=None):
     hist = h.ensure_indexed(hist)
     lint_history(test, hist)
+    plan_history(test, hist)
     return as_checker(checker).check(test, hist, opts or {})
 
 

@@ -90,6 +90,18 @@ def add_test_opts(parser):
                         help="How many completed ops the monitor batches "
                              "per incremental check (default: 64; "
                              "requires --monitor).")
+    parser.add_argument("--no-searchplan", action="store_true",
+                        help="Disable the search planner "
+                             "(analysis/searchplan.py): check every "
+                             "history as one flat device search instead "
+                             "of partitioning it at keys and sealed "
+                             "quiescent cuts (default: planning on).")
+    parser.add_argument("--searchplan-partitions", default=None,
+                        metavar="NAMES",
+                        help="Comma-separated partition predicates the "
+                             "planner applies (default: "
+                             "per-key,crash-segments; planlint PL015 "
+                             "rejects unknown names).")
     parser.add_argument("--lint", action="store_true",
                         help="Dry run: statically validate the test plan "
                              "(planlint) and exit without contacting any "
@@ -160,6 +172,17 @@ def test_opt_fn(opts):
         opts["monitor"] = {"chunk": chunk} if chunk is not None else True
     elif chunk is not None:
         opts["monitor-chunk"] = chunk
+    # search planner (jepsen_tpu.analysis.searchplan): planning is on
+    # by default, so only an explicit opt-out / predicate list lands
+    # on the map (PL015 warns on explicit-enable without a plannable
+    # checker, so we avoid stamping every test map "explicitly on")
+    if opts.pop("no-searchplan", False):
+        opts["searchplan?"] = False
+    preds = opts.pop("searchplan-partitions", None)
+    if preds is not None:
+        opts["searchplan-partitions"] = [p.strip()
+                                        for p in str(preds).split(",")
+                                        if p.strip()]
     opts.pop("node", None)
     opts.pop("nodes-file", None)
     return opts
@@ -550,6 +573,9 @@ def campaign_cmd(opts):
         if workers is not None or options.get("serve") \
                 or options.get("backends"):
             diags += analysis.planlint.lint_fleet(fleet_cfg)
+        # searchplan knob preflight (PL015) rides along over the base
+        # options every cell is built from, mirroring run_fleet
+        diags += analysis.planlint.searchplan_diags(options)
         if options.get("lint?"):
             print(analysis.render_text(diags, title="campaign lint:"))
             for c in cells_plan:

@@ -280,7 +280,8 @@ def demo_test(options):
     # test-map keys core.run/interpreter/monitor watch (the robustness
     # flags previously never reached the demo test map at all)
     for k in ("op-timeout-ms", "time-limit-s", "abort-grace-s",
-              "monitor", "monitor-chunk"):
+              "monitor", "monitor-chunk", "searchplan?",
+              "searchplan-partitions", "searchplan-min-segment"):
         if options.get(k) is not None:
             test[k] = options[k]
     if name == "bank":

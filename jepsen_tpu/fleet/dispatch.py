@@ -170,6 +170,11 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         "backends": backends,
         "time-limit": base_options.get("time-limit"),
     })
+    # PL015 rides along like PL013/PL014: the workers rebuild test
+    # maps from these base options, so searchplan knob mistakes
+    # (unknown predicate names, carry disabled under the monitor)
+    # surface before any host is contacted
+    diags += planlint.searchplan_diags(base_options)
     if diags:
         logger.warning("%s", render_text(diags,
                                          title="fleet preflight:"))

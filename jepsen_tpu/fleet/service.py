@@ -183,20 +183,53 @@ def check_history(payload):
     t0 = time.monotonic()
     deadline = t0 + timeout_s
 
+    # the search planner runs on every submission (opt out with
+    # "searchplan": false in the payload): sealed quiescent cuts slice
+    # each (sub)history into independent segments checked through the
+    # same engine dispatch, so huge sequential histories that would
+    # blow the one-search budget fit as many small ones
+    plan_on = payload.get("searchplan", True)
+    from ..analysis import searchplan
+
     def check_one(sub):
-        left = deadline - time.monotonic()
-        if left <= 0:
-            return {"valid": "unknown",
-                    "error": "request timeout budget exhausted"}
-        engine_opts = {"timeout_s": left} if engine == "jax-wgl" \
-            else None
         client = lin.prepare_history(jhistory.client_ops(sub))
-        e, init_state = spec.encode(client)
-        r = mengine.check_prefix(spec, e, init_state, engine=engine,
-                                 engine_opts=engine_opts)
-        return {"valid": r.get("valid"), "ops": len(e),
-                **({"error": str(r["error"])} if r.get("error")
-                   else {})}
+        segments = [client]
+        plan_meta = None
+        n_ops = None
+        if plan_on:
+            segs, info = searchplan.plan_segments(spec, client)
+            if len(segs) > 1:
+                segments = [s.events for s in segs]
+                plan_meta = {"segments": len(segs),
+                             "cuts": info["cuts"],
+                             "elided": info["elided"]}
+                # "ops" keeps its unplanned meaning — the logical ops
+                # of the submitted (sub)history, what ONE flat encode
+                # would produce — independent of plan shape (seed
+                # pairs re-encode per segment) or budget timing
+                n_ops = info["rows"] + info["elided"]
+        per_seg = []
+        for seg in segments:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                per_seg.append({"valid": "unknown",
+                                "error": "request timeout budget "
+                                         "exhausted"})
+                continue
+            engine_opts = {"timeout_s": left} if engine == "jax-wgl" \
+                else None
+            e, init_state = spec.encode(seg)
+            if n_ops is None:
+                n_ops = len(e)
+            per_seg.append(mengine.check_prefix(
+                spec, e, init_state, engine=engine,
+                engine_opts=engine_opts))
+        from ..checker.core import merge_valid
+        valid = merge_valid([r.get("valid") for r in per_seg])
+        errs = [str(r["error"]) for r in per_seg if r.get("error")]
+        return {"valid": valid, "ops": n_ops or 0,
+                **({"searchplan": plan_meta} if plan_meta else {}),
+                **({"error": errs[0]} if errs else {})}
 
     try:
         if payload.get("keyed"):

@@ -126,11 +126,34 @@ info_ = info
 
 # -- history utilities (knossos.history surface) ----------------------------
 
+class History(list):
+    """An indexed event list that memoizes derived passes.
+
+    ``checker.core.check`` used to re-walk the full history per
+    analyzer run: every subchecker fanned out by Compose re-ran
+    ``ensure_indexed`` (an O(n) rebuild per subchecker). Returning a
+    History makes that idempotent — the same object flows to every
+    subchecker, histlint, and the search planner. The ``pairs`` memo
+    additionally lets passes that receive the SAME History share one
+    pairing walk — the search planner's per-part segmentation sweep
+    and config estimates do (build_plan History-wraps each part);
+    call sites that derive fresh lists (client_ops, complete) still
+    pay their own walk. Caches are only attached to History instances
+    (created at check time, after which the history no longer
+    mutates); plain lists behave as before."""
+
+    __slots__ = ("_pairs",)
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self._pairs = None
+
+
 def index(history):
     """Assign each event a monotone ``index`` (knossos.history/index;
     called from reference core.clj:227-228 before checking). Returns a new
-    list of Ops; existing indices are overwritten."""
-    out = []
+    History of Ops; existing indices are overwritten."""
+    out = History()
     for i, o in enumerate(history):
         o = Op(o)
         o["index"] = i
@@ -140,16 +163,21 @@ def index(history):
 
 def ensure_indexed(history):
     """Index the history unless every event already carries an index.
+    Idempotent: an already-indexed History returns unchanged (with its
+    memoized passes intact).
 
     Raises HistoryError (naming the offending position) on events that
     are not mappings -- Op(non-dict) used to fail later with an opaque
     ValueError from dict()."""
+    if isinstance(history, History):
+        return history
     for i, o in enumerate(history):
         if not isinstance(o, dict):
             raise HistoryError(
                 f"history event #{i} is not a mapping: {o!r}", index=i)
     if all("index" in o for o in history):
-        return [o if isinstance(o, Op) else Op(o) for o in history]
+        return History(o if isinstance(o, Op) else Op(o)
+                       for o in history)
     return index(history)
 
 
@@ -165,7 +193,14 @@ def pairs(history):
     an open invocation: processes are logically single-threaded, and
     silently dropping the earlier invocation (the old behavior) changes
     which ops the checker sees.
+
+    The result is memoized on History instances (ensure_indexed
+    returns one): timeline, the search planner, and encoders all share
+    one pairing walk per checked history. Callers must treat the
+    returned list as read-only.
     """
+    if isinstance(history, History) and history._pairs is not None:
+        return history._pairs
     open_by_process = {}
     out = []
     order = []
@@ -194,6 +229,8 @@ def pairs(history):
                 out.append((None, o))
     for p in order:
         out.append((open_by_process[p], None))
+    if isinstance(history, History):
+        history._pairs = out
     return out
 
 

@@ -307,15 +307,46 @@ class _IndependentChecker(Checker):
         if lin.algorithm not in ("jax-wgl", "batch", "competition"):
             return None
         try:
+            from .analysis import searchplan
             from .parallel import check_batch_encoded
+            import time as _time
+            plan_on = searchplan.segments_enabled(test)
+            min_seg = searchplan.min_segment(test)
             # the SAME client-op selection as Linearizable.check runs
             # through prepare_history here — the two paths once filtered
             # differently and could diverge on exotic process values
             pairs = []
+            spans = []          # per key: (start, count, info, plan_s)
             for k in ks:
-                pairs.append(lin.spec.encode(
-                    lin.prepare_history(h.client_ops(subs[k]))))
+                client = lin.prepare_history(h.client_ops(subs[k]))
+                segs, info, plan_s = None, None, 0.0
+                if plan_on:
+                    # sealed quiescent cuts slice each key's history
+                    # into independent segments; they all ride the
+                    # SAME batch, so the key axis and the segment axis
+                    # share one compiled kernel per shape bucket
+                    t0 = _time.monotonic()
+                    segs, info = searchplan.plan_segments(
+                        lin.spec, client, min_seg)
+                    plan_s = _time.monotonic() - t0
+                    if len(segs) < 2:
+                        segs = None     # no reduction: encode as-is
+                start = len(pairs)
+                if segs is None:
+                    pairs.append(lin.spec.encode(client))
+                    spans.append((start, 1, None, 0.0))
+                else:
+                    pairs.extend(lin.spec.encode(s.events)
+                                 for s in segs)
+                    spans.append((start, len(segs), info, plan_s))
             batch = check_batch_encoded(lin.spec, pairs, **lin.engine_opts)
+            per_key = []
+            for start, count, info, plan_s in spans:
+                if count == 1 and info is None:
+                    per_key.append(batch[start])
+                else:
+                    per_key.append(searchplan.merge_segment_results(
+                        batch[start:start + count], info, plan_s))
         except Exception:  # noqa: BLE001 - fall back to per-key path
             logger.warning("batched independent check failed; falling back",
                            exc_info=True)
@@ -346,7 +377,7 @@ class _IndependentChecker(Checker):
             self._write_key_files(test, subdir, r, subs[k])
             return k, r
 
-        return dict(bounded_pmap(finish, list(zip(ks, batch))))
+        return dict(bounded_pmap(finish, list(zip(ks, per_key))))
 
     def _write_key_files(self, test, subdir, results, sub):
         """Per-key results.json + history.txt in the store

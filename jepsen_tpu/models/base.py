@@ -132,6 +132,18 @@ class ModelSpec:
     # state vector for failure witnesses (knossos shows e.g.
     # #knossos.model.CASRegister{:value 3}); None = raw int list
     decode_state: Callable = None
+    # optional frozenset of op :f names that never change state (pure
+    # reads) AND always step ok when args/ret are entirely unknown.
+    # The search planner (analysis/searchplan.py) elides unconstrained
+    # non-ok pure ops and lets pure ops float across quiescent cuts.
+    # None = no op is known pure; planning degrades, never misjudges.
+    pure_fs: frozenset = None
+    # optional frozenset of op :f names that are TOTAL (steppable from
+    # every state) and STATE-OBLIVIOUS (the post-state depends only on
+    # the op, e.g. a register write; NOT cas — it isn't total). The
+    # planner's sealed quiescent cuts replay such an op as the next
+    # segment's state seed. None = no cuts for this model.
+    seal_fs: frozenset = None
     # optional fn(e, invoke32, ret32) -> bool[n] keep mask | None: ops
     # whose mask is False are removed from the search's candidate set
     # entirely. Must be validity-preserving BOTH ways (the check with and

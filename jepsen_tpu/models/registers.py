@@ -140,6 +140,8 @@ register_spec = register_model(ModelSpec(
     make_oracle=Register,
     encode_op=_register_encode,
     decode_state=_reg_decode,
+    pure_fs=frozenset({"read"}),
+    seal_fs=frozenset({"write"}),
 ))
 
 
@@ -178,6 +180,10 @@ cas_register_spec = register_model(ModelSpec(
     make_oracle=CASRegister,
     encode_op=_cas_encode,
     decode_state=_reg_decode,
+    # cas is state-oblivious when it succeeds but NOT total (it fails
+    # from a mismatched state), so only write seals a quiescent cut
+    pure_fs=frozenset({"read"}),
+    seal_fs=frozenset({"write"}),
 ))
 
 
@@ -220,4 +226,7 @@ def multi_register_spec(keys):
         decode_state=lambda st: {
             "values": {k: (None if int(st[i]) == NIL else int(st[i]))
                        for k, i in k_index.items()}},
+        # a multi-register write only touches the keys it names, so it
+        # is NOT state-oblivious: reads are pure, nothing seals
+        pure_fs=frozenset({"read"}),
     )

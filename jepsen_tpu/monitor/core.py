@@ -59,7 +59,9 @@ ABORT_REASON = "monitor-violation"
 def config(test):
     """Normalize ``test["monitor"]`` (True | chunk int | options dict)
     into an options dict, or None when monitoring is off. Recognized
-    keys: chunk, engine, engine-opts, skip-offline?, final?."""
+    keys: chunk, engine, engine-opts, skip-offline?, final?,
+    quiescent-carry? (default True: truncate proven prefixes at sealed
+    quiescent cuts so chunk checks stay O(window))."""
     mon = test.get("monitor")
     if not mon:
         return None
@@ -120,7 +122,7 @@ class Monitor:
 
     def __init__(self, spec, latch, chunk=DEFAULT_CHUNK,
                  engine="jax-wgl", engine_opts=None, init_ops=(),
-                 keyed=False, device_sem=None):
+                 keyed=False, device_sem=None, quiescent_carry=True):
         self.spec = spec
         self.latch = latch
         self.chunk = max(1, int(chunk))
@@ -129,6 +131,13 @@ class Monitor:
         self.init_ops = list(init_ops or ())
         self.keyed = keyed
         self.device_sem = device_sem
+        #: quiescent-cut carry (analysis/searchplan.py): after a True
+        #: prefix verdict, the encoder truncates to the latest sealed
+        #: quiescent cut, so crash-free monitored runs re-check
+        #: O(window) instead of O(prefix). Off via the monitor config
+        #: {"quiescent-carry?": False} (planlint PL015 flags that).
+        self.quiescent_carry = bool(quiescent_carry)
+        self.truncated_ops = 0
         self.violation = None
         # sinks captured at construction (inside the run's obs scope):
         # overlapping campaign cells must not cross-attribute monitor
@@ -224,6 +233,8 @@ class Monitor:
         }
         if self.unkeyed_skipped:
             out["unkeyed_ops_skipped"] = self.unkeyed_skipped
+        if self.quiescent_carry:
+            out["quiescent_truncated_ops"] = self.truncated_ops
         if self.violation is not None:
             out.update(self.violation)
         return out
@@ -305,6 +316,27 @@ class Monitor:
             if self._reg is not None:
                 self._reg.set_gauge("monitor.time_to_first_verdict_s",
                                     self._t_first_verdict)
+        if valid is True and self.quiescent_carry:
+            # the whole consumed prefix just proved linearizable:
+            # carry the latest sealed quiescent cut so the next check
+            # covers only the open window, not the ever-growing prefix
+            # (decrease-and-conquer, arxiv 2410.04581). Contained: a
+            # carry bug must never change a verdict, only cost —
+            # UNLESS skip-offline? hands the monitor verdict over as
+            # final, where the carry is verdict-bearing (PL015 warns
+            # on that combination).
+            try:
+                from ..analysis import searchplan
+                cut = searchplan.stream_cut(self.spec, e)
+                if cut is not None:
+                    dropped = enc.truncate_before(*cut)
+                    if dropped:
+                        self.truncated_ops += dropped
+                        self._inc("monitor.quiescent_truncated_ops",
+                                  dropped)
+            except Exception:  # noqa: BLE001 - telemetry-grade only
+                logger.warning("quiescent-cut carry failed",
+                               exc_info=True)
         if valid == "unknown":
             self.unknown_checks += 1
             # an undecided check leaves the key "unknown" until a
@@ -386,6 +418,17 @@ class Monitor:
                 self._step()
 
 
+def _searchplan_segments_on(test):
+    """searchplan.segments_enabled, contained: the carry defaults ON
+    when the reflection itself fails (matching the pre-gate default),
+    never crashes install."""
+    try:
+        from ..analysis import searchplan
+        return searchplan.segments_enabled(test)
+    except Exception:  # noqa: BLE001 - best-effort gate
+        return bool(test.get("searchplan?", True))
+
+
 def install(test):
     """Wire a Monitor into a prepared test map (``core.run`` calls
     this after preflight): discover the Linearizable gate in the
@@ -417,7 +460,15 @@ def install(test):
             engine=engine,
             engine_opts=cfg.get("engine-opts") or lin.engine_opts,
             init_ops=lin.init_ops, keyed=keyed,
-            device_sem=test.get("monitor-device-sem"))
+            device_sem=test.get("monitor-device-sem"),
+            # the carry honors BOTH knobs: its own monitor option and
+            # the test-wide searchplan gate INCLUDING the predicate
+            # list (a user disabling the planner or just the
+            # crash-segments predicate to rule the cut code out must
+            # actually stop it running; planlint PL015 warns either
+            # way)
+            quiescent_carry=(cfg.get("quiescent-carry?", True)
+                             and _searchplan_segments_on(test)))
         test.setdefault("op-sinks", []).append(mon.offer)
         obs.inc("monitor.installed", engine=engine)
         return mon.start()

@@ -152,6 +152,27 @@ class StreamEncoder:
     def __len__(self):
         return sum(1 for r in self.rows if not r.dead)
 
+    def truncate_before(self, cut_invoke_idx, seed_invoke_idx=None):
+        """Quiescent-cut carry (analysis/searchplan.py stream_cut):
+        drop rows that invoked before ``cut_invoke_idx``, keeping the
+        sealing seed row (its completed pair re-establishes the state
+        the prefix linearization ended in). Only sound right after a
+        prefix check returned True — the monitor enforces that. Rows
+        still open (in ``_open``) always invoke at/after a valid cut,
+        so the open map stays consistent. Returns the number of rows
+        dropped."""
+        keep = []
+        dropped = 0
+        for r in self.rows:
+            if r.invoke_idx >= cut_invoke_idx \
+                    or r.invoke_idx == seed_invoke_idx:
+                keep.append(r)
+            else:
+                dropped += 1
+        if dropped:
+            self.rows = keep
+        return dropped
+
     def materialize(self):
         """The encoded prefix: (EncodedHistory, init_state). Open rows
         appear as info ops, exactly like an offline encoding of the

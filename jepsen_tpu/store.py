@@ -38,7 +38,8 @@ base_dir = "store"
 DEFAULT_NONSERIALIZABLE_KEYS = {
     "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
     "remote", "barrier", "sessions", "dummy-log", "obs",
-    "analysis-done?", "abort", "journal", "partial-history",
+    "analysis-done?", "searchplan-done?", "abort",
+    "journal", "partial-history",
     "op-sinks", "monitor-device-sem",
 }
 

@@ -280,7 +280,10 @@ def test_independent_engine_opts_checkpoint_flows_through(tmp_path,
          "engine_opts": {"checkpoint": ck_path, "timeout_s": 0,
                          "chunk_iters": 1, "checkpoint_every_s": 0}}))
     r = cc.check(c, {}, _hard_keyed_history(keys))
-    assert calls and calls[0] == (4, ck_path)
+    # the search planner may slice keys into more than one segment per
+    # key: at least one pair per key, and the checkpoint path must
+    # reach the batch either way
+    assert calls and calls[0][0] >= 4 and calls[0][1] == ck_path
     # interrupted: some keys unknown, snapshot on disk
     assert os.path.exists(ck_path)
     assert any(res["valid"] == "unknown"

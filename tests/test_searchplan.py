@@ -1,0 +1,640 @@
+"""Search-plan analyzer (jepsen_tpu/analysis/searchplan.py): sealed
+quiescent-cut segmentation, partition predicates, search-dead elision,
+THE verdict-equivalence property (plan-on == plan-off, valid and
+invalid, single- and multi-key, with and without crashes, and across
+monitor chunk sizes 1/8/64), the quiescent-cut carry, planlint PL015,
+jaxlint JX007, the per-value set reduction, the fleet-service planner,
+and the History pairs-walk memoization."""
+
+import pytest
+
+from jepsen_tpu import analysis
+from jepsen_tpu import history as h
+from jepsen_tpu import independent, store
+from jepsen_tpu import monitor as jmon
+from jepsen_tpu.analysis import searchplan
+from jepsen_tpu.checker import checkers as cks
+from jepsen_tpu.checker import jax_wgl, wgl
+from jepsen_tpu.checker.core import check_safe
+from jepsen_tpu.models import base as mbase
+from jepsen_tpu.robust import ChainedLatch
+
+SPEC = mbase.model_spec("cas-register")
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+
+
+# ---------------------------------------------------------------------------
+# history builders
+
+
+class _Ev:
+    """Tiny indexed event-list builder."""
+
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, t, p, f, v):
+        self.events.append({"type": t, "process": p, "f": f, "value": v,
+                            "index": len(self.events)})
+
+
+def quiescent_hist(bursts=3, stale_read=False, crashed_read=False,
+                   crashed_write=False):
+    """Concurrent write||write bursts separated by sealing isolated
+    writes; optional crashed ops and a trailing stale read (invalid
+    only via the real search — value 0 was genuinely written)."""
+    ev = _Ev()
+    for j in range(bursts):
+        x = j * 10
+        ev("invoke", 0, "write", x)
+        ev("invoke", 1, "write", x + 1)
+        ev("ok", 0, "write", x)
+        ev("ok", 1, "write", x + 1)
+        if crashed_read:
+            ev("invoke", 100 + j, "read", None)
+            ev("info", 100 + j, "read", None)
+        if crashed_write and j == 0:
+            ev("invoke", 200, "write", 777)
+            ev("info", 200, "write", 777)
+        ev("invoke", 0, "write", x + 5)
+        ev("ok", 0, "write", x + 5)
+    ev("invoke", 2, "read", None)
+    ev("ok", 2, "read", 0 if stale_read else (bursts - 1) * 10 + 5)
+    return ev.events
+
+
+def keyed_hist(nk=2, bad_key=None, crashed_read=False):
+    """Independent [k v] register histories, quiescent per key."""
+    ev = _Ev()
+    t = independent.tuple_
+    for k in range(nk):
+        for j in range(3):
+            x = j * 10
+            ev("invoke", 2 * k, "write", t(k, x))
+            ev("ok", 2 * k, "write", t(k, x))
+            if crashed_read and j == 1:
+                ev("invoke", 100 + k, "read", t(k, None))
+                ev("info", 100 + k, "read", t(k, None))
+            ev("invoke", 2 * k + 1, "read", t(k, None))
+            ev("ok", 2 * k + 1, "read",
+               t(k, 999 if (k == bad_key and j == 2) else x))
+    return ev.events
+
+
+# ---------------------------------------------------------------------------
+# segmentation units
+
+
+def test_sealed_cuts_found_and_seeded():
+    segs, info = searchplan.segment_events(SPEC, quiescent_hist(3),
+                                           min_segment=1)
+    assert info["cuts"] >= 2
+    assert len(segs) == info["cuts"] + 1
+    # every later segment is seeded by the sealing write's pair
+    for seg in segs[1:]:
+        assert seg.seed is not None
+        assert seg.seed["f"] == "write"
+        # the seed's invoke AND ok events lead the segment
+        assert seg.events[0]["index"] == seg.seed["index"]
+    assert segs[0].seed is None
+
+
+def test_min_segment_coalesces_cuts():
+    hist = quiescent_hist(4)
+    many, _ = searchplan.segment_events(SPEC, hist, min_segment=1)
+    few, info = searchplan.segment_events(SPEC, hist, min_segment=6)
+    assert len(few) < len(many)
+    assert len(few) == info["cuts"] + 1
+
+
+def test_crashed_write_blocks_all_later_cuts():
+    """An unresolved :info write may linearize at ANY later point, so
+    no instant after it is quiescent: segments are crash-isolated."""
+    segs, info = searchplan.segment_events(
+        SPEC, quiescent_hist(3, crashed_write=True), min_segment=1)
+    # the crash lands in burst 0: at most the pre-crash cut(s) survive
+    clean, _ = searchplan.segment_events(SPEC, quiescent_hist(3),
+                                         min_segment=1)
+    assert info["cuts"] < len(clean) - 1
+    assert info["elided"] == 0
+
+
+def test_crashed_reads_elide_and_cuts_survive():
+    """A settled crashed read is unconstrained: elided, and the cuts
+    it would straddle survive."""
+    segs, info = searchplan.segment_events(
+        SPEC, quiescent_hist(3, crashed_read=True), min_segment=1)
+    assert info["elided"] == 3
+    clean, cinfo = searchplan.segment_events(SPEC, quiescent_hist(3),
+                                             min_segment=1)
+    assert info["cuts"] == cinfo["cuts"]
+
+
+def test_model_without_seal_fs_gets_no_cuts():
+    mutex = mbase.model_spec("mutex")
+    ev = _Ev()
+    for j in range(4):
+        ev("invoke", 0, "acquire", None)
+        ev("ok", 0, "acquire", None)
+        ev("invoke", 0, "release", None)
+        ev("ok", 0, "release", None)
+    segs, info = searchplan.segment_events(mutex, ev.events,
+                                           min_segment=1)
+    assert len(segs) == 1 and info["cuts"] == 0
+
+
+def test_overlap_prevents_seal():
+    """A write overlapped by another write cannot seal: the cut state
+    would be ambiguous."""
+    ev = _Ev()
+    ev("invoke", 0, "write", 1)
+    ev("invoke", 1, "write", 2)
+    ev("ok", 0, "write", 1)
+    ev("ok", 1, "write", 2)       # quiescent here, but NOT sealed
+    ev("invoke", 0, "read", None)
+    ev("ok", 0, "read", 2)
+    segs, info = searchplan.segment_events(SPEC, ev.events,
+                                           min_segment=1)
+    assert info["cuts"] == 0
+
+
+# ---------------------------------------------------------------------------
+# THE equivalence property: plan-on == plan-off verdicts
+
+
+def _lin():
+    return cks.linearizable({"model": "cas-register",
+                             "algorithm": "jax-wgl"})
+
+
+HISTORIES = [
+    ("valid-single", lambda: quiescent_hist(3), True),
+    ("invalid-single-stale", lambda: quiescent_hist(3, stale_read=True),
+     False),
+    ("valid-single-crashes",
+     lambda: quiescent_hist(3, crashed_read=True), True),
+    ("invalid-single-crashes",
+     lambda: quiescent_hist(3, stale_read=True, crashed_read=True,
+                            crashed_write=True), False),
+    ("valid-multikey", lambda: keyed_hist(2), True),
+    ("invalid-multikey", lambda: keyed_hist(2, bad_key=1), False),
+    ("valid-multikey-crashes",
+     lambda: keyed_hist(2, crashed_read=True), True),
+]
+
+
+@pytest.mark.parametrize("name,build,expect",
+                         HISTORIES, ids=[x[0] for x in HISTORIES])
+def test_verdict_equivalence_plan_on_vs_off(name, build, expect):
+    hist = build()
+    keyed = any(independent.is_tuple(o.get("value")) for o in hist)
+    checker = independent.checker(_lin()) if keyed else _lin()
+    r_on = check_safe(checker, {"searchplan-min-segment": 1}, hist)
+    r_off = check_safe(checker, {"searchplan?": False}, hist)
+    assert r_on["valid"] is expect, (name, r_on)
+    assert r_off["valid"] is expect, (name, r_off)
+
+
+def test_planned_result_shape_and_witness():
+    """A planned invalid verdict carries the failing segment's witness
+    fields, the searchplan block, and summed diagnostics."""
+    r = check_safe(_lin(), {"searchplan-min-segment": 1},
+                   quiescent_hist(3, stale_read=True))
+    assert r["valid"] is False
+    sp = r.get("searchplan")
+    assert sp and sp["segments"] >= 2
+    assert "failed_segment" in sp
+    assert "op" in r or "configs" in r   # witness survived the merge
+    assert r["valid?"] is False
+
+
+def test_plan_off_has_no_searchplan_block():
+    r = check_safe(_lin(), {"searchplan?": False}, quiescent_hist(3))
+    assert r["valid"] is True
+    assert "searchplan" not in r
+
+
+def test_partitions_without_crash_segments_skips_cut_execution():
+    """searchplan-partitions=['per-key'] must stop the cut code on the
+    EXECUTION paths too, not only in the analysis.json report."""
+    # direct Linearizable path: no segmentation -> no searchplan block
+    r = check_safe(_lin(), {"searchplan-min-segment": 1,
+                            "searchplan-partitions": ["per-key"]},
+                   quiescent_hist(3))
+    assert r["valid"] is True
+    assert "searchplan" not in r
+    # independent batched path: per-key split still batches, but each
+    # key rides as ONE unsegmented search
+    chk = independent.checker(_lin())
+    rk = check_safe(chk, {"searchplan-min-segment": 1,
+                          "searchplan-partitions": ["per-key"]},
+                    keyed_hist(2))
+    assert rk["valid"] is True
+    assert "searchplan" not in rk["results"][0]
+    # the gate itself
+    assert not searchplan.segments_enabled(
+        {"searchplan-partitions": ["per-key"]})
+    assert searchplan.segments_enabled({})
+    assert not searchplan.segments_enabled({"searchplan?": False})
+
+
+def test_confirm_opt_skips_planning():
+    """Oracle confirmation changes the result contract; the planned
+    path must step aside so the flat search honors it."""
+    lin = cks.linearizable({"model": "cas-register",
+                            "algorithm": "jax-wgl",
+                            "engine_opts": {"confirm": True}})
+    r = check_safe(lin, {"searchplan-min-segment": 1},
+                   quiescent_hist(3, stale_read=True))
+    assert r["valid"] is False
+    assert "searchplan" not in r
+
+
+def test_unsegmented_plan_counts_logical_ops():
+    """per-key-only plans (no crash-segments) must report logical op
+    counts, not raw invoke+completion event counts, or JX007 buckets
+    on ~2x what spec.encode pads."""
+    hist = keyed_hist(2)
+    n_ops_per_key = {}
+    for o in hist:
+        v = o.get("value")
+        if independent.is_tuple(v) and o["type"] == "invoke":
+            n_ops_per_key[v.key] = n_ops_per_key.get(v.key, 0) + 1
+    plan = searchplan.build_plan(
+        {"checker": independent.checker(_lin()),
+         "searchplan-partitions": ["per-key"]}, hist)
+    assert len(plan.subsearches) == 2
+    for s in plan.subsearches:
+        assert s.n_ops <= max(n_ops_per_key.values()), s
+
+
+def test_independent_batched_path_reports_segments():
+    chk = independent.checker(_lin())
+    r = check_safe(chk, {"searchplan-min-segment": 1}, keyed_hist(2))
+    assert r["valid"] is True
+    per = r["results"][0]
+    assert per["searchplan"]["segments"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# monitored path: equivalence across chunk sizes, with the carry on
+
+
+def _feed(mon, ops):
+    for i, op in enumerate(ops):
+        mon.offer(dict(op, index=i))
+
+
+@pytest.mark.parametrize("chunk", [1, 8, 64])
+@pytest.mark.parametrize("stale", [False, True])
+def test_monitor_equivalence_with_carry(chunk, stale):
+    hist = quiescent_hist(3, stale_read=stale)
+    e, st = SPEC.encode(hist)
+    offline = wgl.check_encoded(SPEC, e, st)
+    assert offline["valid"] is (not stale)
+    latch = ChainedLatch()
+    mon = jmon.Monitor(SPEC, latch, chunk=chunk, engine="wgl").start()
+    _feed(mon, hist)
+    mon.stop()
+    s = mon.summary()
+    assert s["verdict"] is offline["valid"], (chunk, stale, s)
+    assert "quiescent_truncated_ops" in s
+
+
+def test_monitor_carry_truncates_proven_prefix():
+    """On a quiescent valid stream the encoder must shrink: chunk
+    checks cover O(window), not O(prefix)."""
+    hist = quiescent_hist(6)
+    latch = ChainedLatch()
+    mon = jmon.Monitor(SPEC, latch, chunk=4, engine="wgl").start()
+    _feed(mon, hist)
+    mon.stop()
+    s = mon.summary()
+    assert s["verdict"] is True
+    assert s["quiescent_truncated_ops"] > 0
+    # the surviving window is a fraction of the consumed stream
+    enc = mon._encoders[None]
+    assert len(enc) < s["ops_consumed"]
+
+
+def test_monitor_carry_off_keeps_everything():
+    hist = quiescent_hist(4)
+    latch = ChainedLatch()
+    mon = jmon.Monitor(SPEC, latch, chunk=4, engine="wgl",
+                       quiescent_carry=False).start()
+    _feed(mon, hist)
+    mon.stop()
+    s = mon.summary()
+    assert s["verdict"] is True
+    assert "quiescent_truncated_ops" not in s
+    assert len(mon._encoders[None]) == s["ops_consumed"]
+
+
+def test_stream_cut_blocked_by_open_read():
+    """A still-open read may complete :ok later with a constraining
+    value — it must block the carry (only SETTLED info reads are
+    elidable)."""
+    ev = _Ev()
+    ev("invoke", 0, "write", 1)
+    ev("ok", 0, "write", 1)
+    ev("invoke", 1, "read", None)     # stays open
+    ev("invoke", 0, "write", 2)
+    ev("ok", 0, "write", 2)
+    from jepsen_tpu.monitor.stream import StreamEncoder
+    enc = StreamEncoder(SPEC)
+    for i, op in enumerate(ev.events):
+        enc.offer(op, i)
+    e, _ = enc.materialize()
+    cut = searchplan.stream_cut(SPEC, e)
+    # the only legal cut is before the open read's invoke
+    assert cut is None or cut[0] <= 2
+
+    # settle the read as :info -> it elides, the later cut appears
+    enc.offer({"type": "info", "process": 1, "f": "read",
+               "value": None, "index": 5}, 5)
+    e2, _ = enc.materialize()
+    cut2 = searchplan.stream_cut(SPEC, e2)
+    assert cut2 is not None and cut2[0] > 2
+
+
+# ---------------------------------------------------------------------------
+# per-value partitioning (set/add-read reduction)
+
+
+def _set_hist(lost=False):
+    ev = _Ev()
+    for v in (1, 2, 3):
+        ev("invoke", v, "add", v)
+        ev("ok", v, "add", v)
+    ev("invoke", 0, "read", None)
+    ev("ok", 0, "read", [1, 3] if lost else [1, 2, 3])
+    return ev.events
+
+
+def test_per_value_parts_build_register_histories():
+    parts = searchplan.per_value_parts(_set_hist())
+    assert sorted(parts) == [1, 2, 3]
+    reg = mbase.model_spec("register")
+    for el, evs in parts.items():
+        e, st = reg.encode(evs)
+        assert wgl.check_encoded(reg, e, st)["valid"] is True
+
+
+def test_per_value_read_before_add_stays_valid():
+    # a read completing before add(e) sees e absent (0); the parts
+    # must seed the register's "absent" state with an initial write 0
+    # or every such VALID history checks false-invalid
+    ev = _Ev()
+    ev("invoke", 0, "read", None)
+    ev("ok", 0, "read", [])
+    ev("invoke", 0, "add", 1)
+    ev("ok", 0, "add", 1)
+    ev("invoke", 0, "read", None)
+    ev("ok", 0, "read", [1])
+    ev("invoke", 0, "add", 2)
+    ev("ok", 0, "add", 2)
+    ev("invoke", 0, "read", None)
+    ev("ok", 0, "read", [1, 2])
+    parts = searchplan.per_value_parts(ev.events)
+    reg = mbase.model_spec("register")
+    for el, evs in parts.items():
+        e, st = reg.encode(evs)
+        assert wgl.check_encoded(reg, e, st)["valid"] is True, el
+
+
+def test_per_value_detects_lost_add():
+    parts = searchplan.per_value_parts(_set_hist(lost=True))
+    reg = mbase.model_spec("register")
+    verdicts = {}
+    for el, evs in parts.items():
+        e, st = reg.encode(evs)
+        verdicts[el] = wgl.check_encoded(reg, e, st)["valid"]
+    assert verdicts == {1: True, 2: False, 3: True}
+
+
+def test_per_value_not_applicable_to_registers():
+    assert searchplan.per_value_parts(quiescent_hist(2)) is None
+
+
+# ---------------------------------------------------------------------------
+# the plan report (checker.core.plan_history)
+
+
+def test_plan_report_persists_in_analysis():
+    chk = independent.checker(_lin())
+    test = {"checker": chk, "searchplan-min-segment": 1}
+    check_safe(chk, test, keyed_hist(2))
+    report = test["analysis"]["searchplan"]
+    assert report["summary"]["subsearches"] >= 2
+    codes = [d["code"] for d in report["diagnostics"]]
+    assert "SP001" in codes and "SP004" in codes
+
+
+def test_plan_report_runs_once_per_test():
+    chk = independent.checker(_lin())
+    test = {"checker": chk, "searchplan-min-segment": 1}
+    hist = h.ensure_indexed(keyed_hist(2))
+    from jepsen_tpu.checker.core import plan_history
+    plan_history(test, hist)
+    marker = test["analysis"]["searchplan"]
+    plan_history(test, hist)
+    assert test["analysis"]["searchplan"] is marker
+
+
+def test_plan_opt_out():
+    chk = independent.checker(_lin())
+    test = {"checker": chk, "searchplan?": False}
+    check_safe(chk, test, keyed_hist(2))
+    assert "searchplan" not in test.get("analysis", {})
+
+
+def test_sp005_single_search_warns():
+    plan = searchplan.build_plan({"searchplan-min-segment": 1},
+                                 quiescent_hist(1)[:4], lin=_lin(),
+                                 keyed=False)
+    # 2-op history: nothing to cut -> single sub-search + SP005
+    assert len(plan.subsearches) == 1
+    assert "SP005" in [d.code for d in plan.diagnostics]
+
+
+def test_sp007_unknown_predicate():
+    plan = searchplan.build_plan(
+        {"searchplan-partitions": ["per-key", "bogus"],
+         "searchplan-min-segment": 1},
+        keyed_hist(2), lin=_lin(), keyed=True)
+    assert "SP007" in [d.code for d in plan.diagnostics]
+    assert len(plan.subsearches) >= 2    # per-key still applied
+
+
+# ---------------------------------------------------------------------------
+# planlint PL015
+
+
+def _plan_map(**kw):
+    from jepsen_tpu import client as jc, generator as gen
+    base = {"client": jc.noop, "generator": gen.limit(
+        1, gen.repeat({"f": "read"})), "concurrency": 1}
+    base.update(kw)
+    return base
+
+
+def test_pl015_unknown_predicate_is_error():
+    diags = analysis.planlint.searchplan_diags(
+        {"searchplan-partitions": ["per-key", "nope"]})
+    errs = [d for d in diags if d.code == "PL015"
+            and d.severity == "error"]
+    assert errs and "nope" in errs[0].message
+
+
+def test_pl015_known_predicates_clean():
+    assert not analysis.planlint.searchplan_diags(
+        {"searchplan-partitions": ["per-key", "per-value",
+                                   "crash-segments"]})
+
+
+def test_pl015_bad_min_segment_warns():
+    diags = analysis.planlint.searchplan_diags(
+        {"searchplan-min-segment": 0})
+    assert [d for d in diags if d.code == "PL015"
+            and d.severity == "warning"]
+
+
+def test_pl015_enabled_without_plannable_gate_warns():
+    from jepsen_tpu import checker as cc
+    diags = analysis.planlint.searchplan_diags(
+        {"searchplan?": True, "checker": cc.noop()})
+    assert [d for d in diags if d.code == "PL015"]
+    # with a linearizable gate: clean
+    assert not analysis.planlint.searchplan_diags(
+        {"searchplan?": True, "checker": _lin()})
+
+
+def test_pl015_monitor_without_carry_warns():
+    diags = analysis.planlint.searchplan_diags(
+        {"monitor": {"quiescent-carry?": False}, "checker": _lin()})
+    assert [d for d in diags if d.code == "PL015"]
+    diags2 = analysis.planlint.searchplan_diags(
+        {"monitor": True, "searchplan?": False, "checker": _lin()})
+    assert [d for d in diags2 if d.code == "PL015"]
+    assert not analysis.planlint.searchplan_diags(
+        {"monitor": True, "checker": _lin()})
+
+
+def test_pl015_skip_offline_with_carry_warns():
+    # skip-offline? makes the monitor verdict final, so the
+    # quiescent-cut carry loses its offline backstop
+    diags = analysis.planlint.searchplan_diags(
+        {"monitor": {"skip-offline?": True}, "checker": _lin()})
+    assert [d for d in diags if d.code == "PL015"
+            and "skip-offline" in d.message]
+    # carry off alongside it: the combination rule stays quiet (the
+    # no-carry warning fires instead)
+    diags2 = analysis.planlint.searchplan_diags(
+        {"monitor": {"skip-offline?": True, "quiescent-carry?": False},
+         "checker": _lin()})
+    assert not [d for d in diags2 if "skip-offline" in d.message]
+
+
+def test_pl015_flows_through_lint_plan():
+    diags = analysis.lint_plan(_plan_map(
+        **{"searchplan-partitions": ["bogus"]}))
+    assert [d for d in diags if d.code == "PL015"]
+
+
+# ---------------------------------------------------------------------------
+# jaxlint JX007
+
+
+def test_jx007_shape_proliferation():
+    from jepsen_tpu.analysis import jaxlint
+    # 6 distinct pow-2 buckets > MAX_PLAN_SHAPES
+    diags = jaxlint.lint_searchplan_shapes([8, 20, 40, 80, 300, 900,
+                                            2000])
+    assert [d for d in diags if d.code == "JX007"]
+    assert "set_n_floor" in diags[0].fix_hint
+    # same sizes, generous floor -> one bucket, clean
+    from jepsen_tpu.campaign import compile_cache
+    compile_cache.set_n_floor(4096)
+    try:
+        assert not jaxlint.lint_searchplan_shapes(
+            [8, 20, 40, 80, 300, 900, 2000])
+    finally:
+        compile_cache.set_n_floor(1)
+
+
+def test_jx007_few_shapes_clean():
+    from jepsen_tpu.analysis import jaxlint
+    assert not jaxlint.lint_searchplan_shapes([8, 8, 9, 15, 16, 16])
+
+
+# ---------------------------------------------------------------------------
+# fleet service planning
+
+
+def test_service_check_plans_and_matches():
+    from jepsen_tpu.fleet import service
+    hist = quiescent_hist(3)
+    on = service.check_history({"history": hist, "model":
+                                "cas-register"})
+    off = service.check_history({"history": hist, "model":
+                                 "cas-register", "searchplan": False})
+    assert on["valid"] is True and off["valid"] is True
+    assert on.get("searchplan", {}).get("segments", 0) >= 2 \
+        or "searchplan" not in on   # min-segment may coalesce
+    bad_on = service.check_history(
+        {"history": quiescent_hist(3, stale_read=True),
+         "model": "cas-register"})
+    bad_off = service.check_history(
+        {"history": quiescent_hist(3, stale_read=True),
+         "model": "cas-register", "searchplan": False})
+    assert bad_on["valid"] is False and bad_off["valid"] is False
+
+
+# ---------------------------------------------------------------------------
+# History memoization (the shared index/pairs walk)
+
+
+def test_ensure_indexed_idempotent():
+    hist = h.ensure_indexed(quiescent_hist(2))
+    assert isinstance(hist, h.History)
+    assert h.ensure_indexed(hist) is hist
+
+
+def test_pairs_memoized_on_history():
+    hist = h.ensure_indexed(quiescent_hist(2))
+    assert h.pairs(hist) is h.pairs(hist)
+    # plain lists keep the old no-cache behavior
+    plain = quiescent_hist(2)
+    assert h.pairs(plain) is not h.pairs(plain)
+
+
+def test_pairs_cache_not_shared_across_objects():
+    a = h.ensure_indexed(quiescent_hist(2))
+    b = h.ensure_indexed(quiescent_hist(2))
+    assert h.pairs(a) is not h.pairs(b)
+
+
+# ---------------------------------------------------------------------------
+# merge helper
+
+
+def test_merge_segment_results_shapes():
+    merged = searchplan.merge_segment_results(
+        [{"valid": True, "configs_explored": 3, "iterations": 2},
+         {"valid": False, "configs_explored": 5, "iterations": 7,
+          "op": {"f": "read"}},
+         {"valid": True, "configs_explored": 1, "iterations": 1}])
+    assert merged["valid"] is False
+    assert merged["configs_explored"] == 9
+    assert merged["iterations"] == 7
+    assert merged["op"] == {"f": "read"}
+    assert merged["searchplan"]["failed_segment"] == 1
+
+    unk = searchplan.merge_segment_results(
+        [{"valid": True}, {"valid": "unknown", "error": "timeout"}])
+    assert unk["valid"] == "unknown"
+    assert unk["error"] == "timeout"

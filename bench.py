@@ -242,6 +242,116 @@ def _fleet_reuse_rung(time_limit_s=3, budget_s=600):
         return {"error": repr(exc)[:300]}
 
 
+def _fleet_survival_rung(time_limit_s=2, budget_s=900):
+    """Fleet survivability (jepsen_tpu.fleet sync/chaos): the same
+    2-seed register matrix dispatched to 2 loopback workers with an
+    ISOLATED worker store (artifact sync on), three ways:
+
+      clean        no faults: baseline fleet wall clock
+      chaos        --chaos-profile soak:7 (exit-255s, a hang, a
+                   kill -9, a partial download, torn ledger tail):
+                   wall clock + lease/steal/sync counts -- the price
+                   of surviving, and proof every recovery path ran
+      warm         the clean matrix again in a FRESH process sharing
+                   the same store: with the persistent jax
+                   compilation cache enabled, the restart should stop
+                   paying the XLA compiles the first run did
+
+    chaos_overhead_x is chaos wall / clean wall; warm reports the
+    ledger's cold/warm wall split and the jax cache population.
+    Self-contained and never fatal: a survivability regression must
+    show up as numbers (or an error field), not break the bench."""
+    import os
+    import subprocess
+    import tempfile
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        workdir = tempfile.mkdtemp(prefix="jepsen-fleet-survival-")
+        env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
+        out = {"matrix": "workload=register x seeds=2",
+               "time_limit_s": time_limit_s}
+        # NB the warm phase REUSES the clean phase's worker store: the
+        # persistent jax compilation cache + compile ledger live
+        # there, and surviving a process restart is their whole claim
+        wstores = {"clean": "wstore-clean", "chaos": "wstore-chaos",
+                   "warm": "wstore-clean"}
+        for phase, extra in (("clean", []),
+                             ("chaos", ["--chaos-profile", "soak:7"]),
+                             ("warm", [])):
+            t0 = time.monotonic()
+            p = subprocess.run(
+                [sys.executable, "-m", "jepsen_tpu", "campaign",
+                 "--no-ssh", "--time-limit", str(time_limit_s),
+                 "--axis", "workload=register", "--seeds", "2",
+                 "--parallel", "2", "--workers", "local,local",
+                 "--lease", "300", "--max-leases", "5",
+                 "--sync-timeout", "60",
+                 "--worker-store",
+                 os.path.join(workdir, wstores[phase]),
+                 "--campaign-id", f"survival-{phase}", *extra],
+                cwd=workdir, capture_output=True, text=True,
+                timeout=budget_s, env=env)
+            wall = round(time.monotonic() - t0, 1)
+            cdir = os.path.join(workdir, "store", "campaigns",
+                                f"survival-{phase}")
+            recs = []
+            with open(os.path.join(cdir, "cells.jsonl")) as f:
+                for ln in f:
+                    try:
+                        recs.append(json.loads(ln))
+                    except ValueError:
+                        pass
+            ev = [r for r in recs if r.get("event")]
+            outcomes = [r for r in recs if not r.get("event")]
+            out[phase] = {
+                "wall_s": wall, "exit": p.returncode,
+                "cells": len(outcomes),
+                "ok": sum(1 for r in outcomes
+                          if r.get("outcome") is True),
+                "leases": sum(1 for e in ev
+                              if e["event"] == "lease"),
+                "steals": sum(1 for e in ev
+                              if e["event"] == "lease-failed"),
+                "syncs_ok": sum(1 for e in ev
+                                if e["event"] == "artifact-sync"
+                                and e.get("status") == "ok"),
+                "syncs_failed": sum(1 for e in ev
+                                    if e["event"] == "artifact-sync"
+                                    and e.get("status") == "failed"),
+                "mirrored": sum(1 for r in outcomes
+                                if r.get("synced") is True
+                                and os.path.isdir(str(r.get("path")))),
+            }
+        if out["clean"]["wall_s"]:
+            out["chaos_overhead_x"] = round(
+                out["chaos"]["wall_s"] / out["clean"]["wall_s"], 2)
+        from jepsen_tpu.fleet import ledger as fledger
+        led = fledger.Ledger(os.path.join(workdir, "store",
+                                          "compile_ledger"))
+        st = led.stats()
+        jax_cache = os.path.join(workdir, "store", "compile_ledger",
+                                 fledger.JAX_CACHE_DIR)
+        # the workers compile in their own stores; the jax cache that
+        # matters for warm restarts is per worker store
+        caches = [os.path.join(workdir, d, "compile_ledger",
+                               fledger.JAX_CACHE_DIR)
+                  for d in ("wstore-clean", "wstore-chaos")] \
+            + [jax_cache]
+        out["warm_restart"] = {
+            "cold_wall_s": st.get("cold_wall_s"),
+            "warm_wall_s": st.get("warm_wall_s"),
+            "warm_vs_clean_x": round(
+                out["warm"]["wall_s"] / out["clean"]["wall_s"], 2)
+            if out["clean"]["wall_s"] else None,
+            "jax_cache_files": sum(
+                len(files) for c in caches if os.path.isdir(c)
+                for _, _, files in os.walk(c)),
+        }
+        return out
+    except Exception as exc:  # noqa: BLE001 - numbers, not crashes
+        return {"error": repr(exc)[:300]}
+
+
 def _searchplan_rung(keys=4, bursts=6):
     """Search-plan reduction (jepsen_tpu.analysis.searchplan): the
     same quiescent multi-key cas-register batch checked with planning
@@ -830,6 +940,11 @@ def _bench_body(_obs_reg):
     # search-plan rung: quiescent-cut slicing must beat the flat batch
     # on explored configs, with the planner itself in the noise
     rungs["9-searchplan"] = _searchplan_rung()
+
+    # fleet-survival rung: the chaos soak's wall-clock price vs the
+    # clean fleet, plus the warm-restart win from the persistent jax
+    # compilation cache (CPU subprocesses; see the rung's docstring)
+    rungs["10-fleet-survival"] = _fleet_survival_rung()
 
     # CPU oracles race in parallel subprocesses AFTER all device
     # measurements (their CPU load would pollute the device numbers);

@@ -51,6 +51,13 @@ Codes:
                  monitor armed with quiescent-cut carry disabled
                  (crash-free monitored runs then re-check O(prefix),
                  not O(window)) — warnings
+  PL016 mixed    fleet/service robustness: a non-loopback --serve
+                 bind with no auth token, or non-positive admission
+                 budget / queue-wait / artifact-sync-timeout knobs
+                 (errors); an artifact-sync timeout at or beyond the
+                 worker lease, so syncing holds a finished cell's
+                 lease open longer than the death-detection bound
+                 (warning)
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -68,9 +75,9 @@ from .histlint import model_op_set
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["lint_plan", "lint_campaign", "lint_fleet", "preflight",
-           "PlanLintError", "FATAL_CODES", "monitor_diags",
-           "searchplan_diags"]
+__all__ = ["lint_plan", "lint_campaign", "lint_fleet", "lint_service",
+           "preflight", "PlanLintError", "FATAL_CODES",
+           "monitor_diags", "searchplan_diags"]
 
 #: error codes certain enough to abort the run before node contact
 FATAL_CODES = {"PL001", "PL003", "PL004", "PL005", "PL006"}
@@ -551,6 +558,78 @@ def lint_fleet(cfg):
             "fleet.lease-s",
             "set the lease comfortably above the cell budget "
             "(time-limit plus setup/check headroom)"))
+    return diags
+
+
+#: serve bind addresses that never leave the machine: anything else
+#: exposes /api to the network and PL016 demands a token for it
+_LOOPBACK_BINDS = ("127.0.0.1", "::1", "localhost")
+
+
+def lint_service(cfg):
+    """PL016: fleet/service robustness preflight, before any socket is
+    bound or artifact synced. Recognized keys: ``serve?``,
+    ``serve-ip`` (the bind address), ``auth-token?`` (whether any
+    token is configured), ``budgets`` (the service.Admission budget
+    mapping), ``queue-wait-s``, ``sync-timeout-s``, and ``lease-s``
+    (for the sync-vs-lease warning)."""
+    diags = []
+    cfg = cfg or {}
+    if cfg.get("serve?"):
+        ip = cfg.get("serve-ip")
+        # an unset bind means the historical default 0.0.0.0: the
+        # most exposed case, not an excuse to skip the check
+        if str(ip or "0.0.0.0") not in _LOOPBACK_BINDS \
+                and not cfg.get("auth-token?"):
+            diags.append(diag(
+                "PL016", ERROR,
+                f"--serve binds {ip or '0.0.0.0'!r} (non-loopback) "
+                "with no auth token: anyone who can reach the port "
+                "can submit NP-hard checks and campaigns",
+                "service.auth-token",
+                "pass --auth-token (or bind 127.0.0.1)"))
+    budgets = cfg.get("budgets")
+    if isinstance(budgets, dict):
+        for k in ("concurrent-checks", "queue-depth", "campaigns",
+                  "ops-per-day"):
+            v = budgets.get(k)
+            if v is None:
+                continue
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                diags.append(diag(
+                    "PL016", ERROR,
+                    f"service budget {k!r} must be a positive "
+                    f"integer, got {v!r}",
+                    f"service.budgets.{k}",
+                    "a zero/negative budget rejects every request; "
+                    "omit the key for the default"))
+    qw = cfg.get("queue-wait-s")
+    if qw is not None and (not isinstance(qw, (int, float))
+                           or isinstance(qw, bool) or qw <= 0):
+        diags.append(diag(
+            "PL016", ERROR,
+            f"queue-wait-s must be a positive number, got {qw!r}",
+            "service.queue-wait-s"))
+    st = cfg.get("sync-timeout-s")
+    if st is not None and (not isinstance(st, (int, float))
+                           or isinstance(st, bool) or st <= 0):
+        diags.append(diag(
+            "PL016", ERROR,
+            f"sync-timeout-s must be a positive number, got {st!r}",
+            "fleet.sync-timeout-s",
+            "the artifact-sync wall bound is what keeps a wedged "
+            "download from wedging the coordinator"))
+        st = None
+    lease = cfg.get("lease-s")
+    if st is not None and isinstance(lease, (int, float)) \
+            and not isinstance(lease, bool) and 0 < lease <= st:
+        diags.append(diag(
+            "PL016", WARNING,
+            f"sync-timeout-s {st:g} >= lease-s {lease:g}: syncing a "
+            "finished cell holds its lease open longer than the "
+            "worker-death detection bound itself",
+            "fleet.sync-timeout-s",
+            "keep the artifact-sync budget well under the lease TTL"))
     return diags
 
 

@@ -219,6 +219,12 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
         t0 = time.monotonic()
         rec = {"cell": cid, "group": cell.get("group") or cid,
                "params": cell.get("params") or {}}
+        # per-cell compile-reuse delta: exact at --parallel 1; under a
+        # wider pool, concurrent cells' counters cross-attribute, but
+        # the SUM stays right and a cell with misses > 0 definitely
+        # overlapped a compile -- good enough for the cold/warm wall
+        # fold the ledger stats event carries
+        cc_cell = compile_cache.stats()
         test = None
         with tr.span("campaign.cell", cat="campaign",
                      args={"cell": cid}):
@@ -272,6 +278,7 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
             # recovery must never take down the campaign loop
             rec["path"] = None
         rec["wall_s"] = round(time.monotonic() - t0, 3)
+        rec["compile-cache"] = compile_cache.delta(cc_cell)
         jr.append_cell(rec)
         reg.inc("campaign.cells", outcome=str(rec["outcome"]))
         reg.observe("campaign.cell_s", rec["wall_s"])
@@ -319,8 +326,17 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
     if led is not None:
         # persist this campaign's reuse delta, then surface the
         # cross-process aggregate: hits observed across SEPARATE
-        # scheduler processes are the ledger's whole point
-        led.note_stats(cc["hits"], cc["misses"])
+        # scheduler processes are the ledger's whole point. The
+        # cold/warm wall split is the persistent jax compile cache's
+        # before/after evidence (see fleet.ledger.enable_jax_cache)
+        from ..fleet.ledger import fold_walls
+        # THIS run's cells only: resumed cells' walls already landed
+        # in the prior process's stats event, and Ledger.stats sums
+        # events -- re-folding them would inflate cold/warm per resume
+        cold, warm = fold_walls([r for r in jr.latest()
+                                 if str(r.get("cell")) not in done])
+        led.note_stats(cc["hits"], cc["misses"], cold_wall_s=cold,
+                       warm_wall_s=warm)
         try:
             cc = dict(cc, ledger=led.stats())
         except Exception:  # noqa: BLE001 - bookkeeping only

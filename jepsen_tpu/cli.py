@@ -397,6 +397,13 @@ def _add_campaign_opts(parser, axes=False):
                                  "past this is presumed dead and its "
                                  "cell is stolen by another worker "
                                  "(default 600).")
+        parser.add_argument("--max-leases", type=int, default=None,
+                            metavar="N",
+                            help="How many leases a cell may burn "
+                                 "before it journals as crashed "
+                                 "(default 3; raise it for chaos "
+                                 "soaks, where injected faults and "
+                                 "real recoveries share the budget).")
         parser.add_argument("--serve", action="store_true",
                             help="Serve the web UI + submission API "
                                  "(POST /api/check, /api/campaigns) "
@@ -405,6 +412,38 @@ def _add_campaign_opts(parser, axes=False):
         parser.add_argument("--serve-port", type=int, default=8080,
                             metavar="PORT",
                             help="Port for --serve (default 8080).")
+        parser.add_argument("--serve-ip", default="0.0.0.0",
+                            metavar="IP",
+                            help="Bind address for --serve (default "
+                                 "0.0.0.0; a non-loopback bind "
+                                 "requires --auth-token, PL016).")
+        parser.add_argument("--auth-token", default=None,
+                            metavar="TOKEN",
+                            help="Bearer token /api requests must "
+                                 "present (401 otherwise) when "
+                                 "--serve is on.")
+        parser.add_argument("--worker-store", default=None,
+                            metavar="DIR",
+                            help="Store directory the fleet WORKERS "
+                                 "write runs into (default: the "
+                                 "coordinator's own store). Pointing "
+                                 "it elsewhere gives workers isolated "
+                                 "stores and turns artifact sync on "
+                                 "for loopback workers too.")
+        parser.add_argument("--sync-timeout", type=float, default=None,
+                            metavar="SECONDS",
+                            help="Wall bound for mirroring one remote "
+                                 "cell's run directory into the "
+                                 "coordinator store (default 120).")
+        parser.add_argument("--chaos-profile", default=None,
+                            metavar="NAME[:SEED]",
+                            help="Fleet chaos soak: inject a seeded, "
+                                 "deterministic fault schedule "
+                                 "(exit-255s, hangs, partial "
+                                 "downloads, worker kill -9s) into "
+                                 "the dispatch control plane; "
+                                 "profiles: none, flaky-exec, "
+                                 "lossy-sync, soak (e.g. soak:42).")
         parser.add_argument("--axis", action="append", default=[],
                             metavar="NAME=V1,V2,...",
                             help="A sweep axis: option NAME takes each "
@@ -497,9 +536,11 @@ def parse_axes(specs, seeds=None):
 #: option keys that are coordinator-local wiring, never shipped to a
 #: fleet worker's cell spec
 _FLEET_LOCAL_OPTS = {
-    "argv", "workers", "lease", "serve", "serve-port", "no-ledger",
-    "backends", "axis", "seeds", "parallel", "device-slots",
-    "campaign-id", "resume", "lint?",
+    "argv", "workers", "lease", "max-leases", "serve", "serve-port",
+    "serve-ip",
+    "auth-token", "worker-store", "sync-timeout", "chaos-profile",
+    "no-ledger", "backends", "axis", "seeds", "parallel",
+    "device-slots", "campaign-id", "resume", "lint?",
 }
 
 
@@ -573,6 +614,22 @@ def campaign_cmd(opts):
         if workers is not None or options.get("serve") \
                 or options.get("backends"):
             diags += analysis.planlint.lint_fleet(fleet_cfg)
+        # service/sync robustness preflight (PL016) rides along the
+        # same way whenever serving or fleet sync knobs are in play
+        if workers is not None or options.get("serve"):
+            diags += analysis.planlint.lint_service({
+                "serve?": bool(options.get("serve")),
+                "serve-ip": options.get("serve-ip"),
+                "auth-token?": bool(options.get("auth-token")),
+                "sync-timeout-s": options.get("sync-timeout"),
+                "lease-s": options.get("lease"),
+            })
+        if options.get("chaos-profile"):
+            from .fleet import chaos as fchaos
+            try:
+                fchaos.parse(options["chaos-profile"])
+            except ValueError as e:
+                raise CliError(str(e)) from None
         # searchplan knob preflight (PL015) rides along over the base
         # options every cell is built from, mirroring run_fleet
         diags += analysis.planlint.searchplan_diags(options)
@@ -587,8 +644,9 @@ def campaign_cmd(opts):
                 title="campaign matrix invalid:"))
         if options.get("serve"):
             from . import web
-            web.serve({"ip": "0.0.0.0",
-                       "port": options.get("serve-port", 8080)})
+            web.serve({"ip": options.get("serve-ip", "0.0.0.0"),
+                       "port": options.get("serve-port", 8080),
+                       "token": options.get("auth-token")})
         if workers is not None:
             from . import fleet
             try:
@@ -598,12 +656,19 @@ def campaign_cmd(opts):
                     resume=bool(options.get("resume")),
                     lease_s=options.get("lease")
                     or fleet.dispatch.DEFAULT_LEASE_S,
+                    max_leases=options.get("max-leases")
+                    or fleet.dispatch.MAX_LEASES,
                     builder=opts.get("builder"),
                     base_options=_jsonable_options(options),
                     ledger=not options.get("no-ledger"),
                     backends=options.get("backends") or None,
                     serve=bool(options.get("serve")),
-                    device_slots=options.get("device-slots", 1))
+                    device_slots=options.get("device-slots", 1),
+                    worker_store_dir=options.get("worker-store"),
+                    sync_timeout_s=options.get("sync-timeout"),
+                    chaos=options.get("chaos-profile"),
+                    serve_ip=options.get("serve-ip"),
+                    auth_token=options.get("auth-token"))
             except fleet.FleetError as e:
                 raise CliError(str(e)) from e
             print(campaign.report.render_text(report))
@@ -661,11 +726,25 @@ def serve_cmd():
                             help="Hostname to bind to")
         parser.add_argument("-p", "--port", type=int, default=8080,
                             help="Port number to bind to")
+        parser.add_argument("--token", default=None, metavar="TOKEN",
+                            help="Bearer token /api requests must "
+                                 "present (401 otherwise); PL016 "
+                                 "demands one for non-loopback binds.")
 
     def run_serve(options):
         from . import web
+        from .analysis import planlint, render_text, errors
+        diags = planlint.lint_service({
+            "serve?": True, "serve-ip": options.get("host"),
+            "auth-token?": bool(options.get("token"))})
+        if diags:
+            print(render_text(diags, title="serve preflight:"))
+        if errors(diags):
+            raise CliError("refusing to serve: bind 127.0.0.1 or "
+                           "pass --token")
         web.serve({"ip": options.get("host", "0.0.0.0"),
-                   "port": options.get("port", 8080)})
+                   "port": options.get("port", 8080),
+                   "token": options.get("token")})
         print(f"Listening on http://{options.get('host')}:"
               f"{options.get('port')}/")
         try:

@@ -11,6 +11,7 @@ ControlMaster connection pooling just as well."""
 from __future__ import annotations
 
 import logging
+import os
 import subprocess
 
 from ..robust import RetryPolicy
@@ -90,14 +91,15 @@ class SSHRemote(Remote):
             local_paths = [local_paths]
         args, target = self._scp_args()
         return _run(args + list(local_paths) + [f"{target}:{remote_path}"],
-                    {"cmd": "scp upload"})
+                    {"cmd": "scp upload"}, timeout=ctx.get("timeout"))
 
     def download(self, ctx, remote_paths, local_path):
         if isinstance(remote_paths, str):
             remote_paths = [remote_paths]
         args, target = self._scp_args()
         return _run(args + [f"{target}:{p}" for p in remote_paths]
-                    + [local_path], {"cmd": "scp download"})
+                    + [local_path], {"cmd": "scp download"},
+                    timeout=ctx.get("timeout"))
 
 
 class DockerRemote(Remote):
@@ -123,7 +125,8 @@ class DockerRemote(Remote):
         for p in local_paths:
             res = _run(["docker", "cp", p,
                         f"{self.container}:{remote_path}"],
-                       {"cmd": "docker cp"})
+                       {"cmd": "docker cp"},
+                       timeout=ctx.get("timeout"))
         return res
 
     def download(self, ctx, remote_paths, local_path):
@@ -132,7 +135,8 @@ class DockerRemote(Remote):
         res = None
         for p in remote_paths:
             res = _run(["docker", "cp", f"{self.container}:{p}",
-                        local_path], {"cmd": "docker cp"})
+                        local_path], {"cmd": "docker cp"},
+                       timeout=ctx.get("timeout"))
         return res
 
 
@@ -159,7 +163,9 @@ class K8sRemote(Remote):
         res = None
         for p in local_paths:
             res = _run(["kubectl", "cp", "-n", self.namespace, p,
-                        f"{self.pod}:{remote_path}"], {"cmd": "kubectl cp"})
+                        f"{self.pod}:{remote_path}"],
+                       {"cmd": "kubectl cp"},
+                       timeout=ctx.get("timeout"))
         return res
 
     def download(self, ctx, remote_paths, local_path):
@@ -169,7 +175,8 @@ class K8sRemote(Remote):
         for p in remote_paths:
             res = _run(["kubectl", "cp", "-n", self.namespace,
                         f"{self.pod}:{p}", local_path],
-                       {"cmd": "kubectl cp"})
+                       {"cmd": "kubectl cp"},
+                       timeout=ctx.get("timeout"))
         return res
 
 
@@ -204,13 +211,15 @@ class LocalRemote(Remote):
         if isinstance(local_paths, str):
             local_paths = [local_paths]
         return _run(["cp", "-rp", *local_paths, remote_path],
-                    {"cmd": "local cp upload"})
+                    {"cmd": "local cp upload"},
+                    timeout=ctx.get("timeout"))
 
     def download(self, ctx, remote_paths, local_path):
         if isinstance(remote_paths, str):
             remote_paths = [remote_paths]
         return _run(["cp", "-rp", *remote_paths, local_path],
-                    {"cmd": "local cp download"})
+                    {"cmd": "local cp download"},
+                    timeout=ctx.get("timeout"))
 
 
 class DummyRemote(Remote):
@@ -239,6 +248,118 @@ class DummyRemote(Remote):
         self.log.append((self.host,
                          f"download {remote_paths} {local_path}"))
         return {"exit": 0}
+
+
+class FaultyRemote(Remote):
+    """Deterministic fault-injecting wrapper over any Remote: the
+    control plane's OWN nemesis. Jepsen's premise -- systems must be
+    tested under faults -- applies to the harness too: the fleet layer
+    claims to survive flaky transports, and this wrapper is how that
+    claim gets exercised without real broken networks.
+
+    ``faults`` is a callable ``faults(kind) -> fault | None`` where
+    ``kind`` is ``"execute"`` / ``"upload"`` / ``"download"`` and the
+    fault is one of:
+
+    * ``"exit-255"`` -- the action is NOT performed; an ssh-style
+      transport failure result is returned (what `transport_failed`
+      recognizes, so retry/lease machinery sees a real signal);
+    * ``"timeout"`` -- the action is NOT performed; a subprocess
+      timeout result is returned;
+    * ``("hang", seconds)`` -- sleep (a wedged transport), then return
+      the timeout result; the sleep is capped by the ctx timeout so an
+      injected hang can't outlive the caller's own bound;
+    * ``"partial"`` (download only) -- the real download runs, then
+      the largest transferred file is truncated to half: a torn copy
+      that LOOKS successful, which is exactly the fault manifest
+      verification (fleet.sync) must catch.
+
+    The callable owns all randomness/scheduling (seeded upstream, see
+    fleet.chaos), so a given seed replays the same fault pattern."""
+
+    def __init__(self, inner, faults):
+        self.inner = inner
+        self.faults = faults
+
+    def connect(self, conn_spec):
+        return FaultyRemote(self.inner.connect(conn_spec), self.faults)
+
+    def disconnect(self):
+        if hasattr(self.inner, "disconnect"):
+            self.inner.disconnect()
+
+    def _fault_result(self, fault, ctx, action):
+        import time as _t
+        out = dict(action if isinstance(action, dict) else
+                   {"cmd": str(action)})
+        if isinstance(fault, (tuple, list)) and fault and \
+                fault[0] == "hang":
+            hang_s = float(fault[1]) if len(fault) > 1 else 5.0
+            t = (ctx or {}).get("timeout")
+            if t:
+                hang_s = min(hang_s, float(t))
+            logger.warning("chaos: injected %.1fs transport hang",
+                           hang_s)
+            _t.sleep(hang_s)
+            out.update(out="", err="timeout", exit=-1)
+            return out
+        if fault == "timeout":
+            logger.warning("chaos: injected transport timeout")
+            out.update(out="", err="timeout", exit=-1)
+            return out
+        logger.warning("chaos: injected transport exit-255")
+        out.update(out="", err="chaos: injected transport failure",
+                   exit=255)
+        return out
+
+    def _maim(self, local_path):
+        """Truncate the largest file under ``local_path`` to half its
+        size (deterministic victim: size, then name): a partial
+        download that still reports success."""
+        victim, size = None, -1
+        if os.path.isfile(local_path):
+            victim, size = local_path, os.path.getsize(local_path)
+        for root, _dirs, files in os.walk(local_path):
+            for f in sorted(files):
+                p = os.path.join(root, f)
+                try:
+                    s = os.path.getsize(p)
+                except OSError:
+                    continue
+                if s > size:
+                    victim, size = p, s
+        if victim is None or size <= 0:
+            return
+        logger.warning("chaos: truncating partial download %s "
+                       "(%d -> %d bytes)", victim, size, size // 2)
+        with open(victim, "ab") as f:
+            f.truncate(size // 2)
+
+    def execute(self, ctx, action):
+        fault = self.faults("execute")
+        if fault is not None:
+            return self._fault_result(fault, ctx, action)
+        return self.inner.execute(ctx, action)
+
+    def upload(self, ctx, local_paths, remote_path):
+        fault = self.faults("upload")
+        if fault is not None:
+            return self._fault_result(fault, ctx, {"cmd": "upload"})
+        return self.inner.upload(ctx, local_paths, remote_path)
+
+    def download(self, ctx, remote_paths, local_path):
+        fault = self.faults("download")
+        if fault is not None and fault != "partial":
+            return self._fault_result(fault, ctx, {"cmd": "download"})
+        res = self.inner.download(ctx, remote_paths, local_path)
+        if fault == "partial" and isinstance(res, dict) \
+                and res.get("exit") == 0:
+            try:
+                self._maim(local_path)
+            except OSError:  # pragma: no cover - fs hiccup
+                logger.warning("chaos: couldn't maim download",
+                               exc_info=True)
+        return res
 
 
 def transport_failed(result):

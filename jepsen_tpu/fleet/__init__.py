@@ -36,7 +36,8 @@ Submodules that pull in the heavy harness chain load lazily;
 
 from __future__ import annotations
 
-_LAZY = ("ledger", "worker", "dispatch", "service", "backends")
+_LAZY = ("ledger", "worker", "dispatch", "service", "backends",
+         "sync", "chaos")
 
 
 def __getattr__(name):
@@ -52,4 +53,5 @@ def __getattr__(name):
 
 
 __all__ = ["ledger", "worker", "dispatch", "service", "backends",
-           "run_fleet", "FleetError", "parse_workers"]
+           "sync", "chaos", "run_fleet", "FleetError",
+           "parse_workers"]

@@ -81,9 +81,9 @@ class Worker:
         return f"Worker({self.id!r}, {self.host!r}, {self.kind})"
 
     def _base_remote(self):
-        if self.kind == "local":
-            return remotes.LocalRemote()
-        return remotes.SSHRemote()
+        from .sync import resolve_remote
+        base = resolve_remote(self.kind)
+        return (base or remotes.SSHRemote)()
 
     def connect(self):
         """The raw (non-retrying) transport for cell execs."""
@@ -147,21 +147,47 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
               lease_s=DEFAULT_LEASE_S, max_leases=MAX_LEASES,
               builder=None, base_options=None, latch=None, ledger=True,
               backends=None, python=None, cwd=None, serve=False,
-              device_slots=1, probe=True, env=None):
+              device_slots=1, probe=True, env=None, sync="auto",
+              worker_store_dir=None, sync_timeout_s=None, chaos=None,
+              serve_ip=None, auth_token=None):
     """Run a campaign across worker hosts; returns the report dict
     (persisted as report.json, same shape as scheduler.run_cells).
 
     ``cells`` are plan-style ``{"id", "group", "params"}`` maps;
     ``builder`` is the importable ``"pkg.module:fn"`` every worker
     rebuilds test maps with, fed ``base_options`` overlaid with each
-    cell's params. ``serve``/``device_slots`` participate only in the
-    PL014 preflight (the CLI co-launches the service)."""
+    cell's params. ``serve``/``device_slots``/``serve_ip``/
+    ``auth_token`` participate only in the PL014/PL016 preflight (the
+    CLI co-launches the service).
+
+    **Artifact sync** (``sync``): ``"auto"`` mirrors each remote
+    cell's run directory into the coordinator store over the scp
+    plane whenever the worker's store isn't this process's store (ssh
+    workers, or any worker when ``worker_store_dir`` points workers
+    at their own directory); ``True``/``False`` force it. Sync
+    happens under the cell's lease (extended by ``sync_timeout_s``,
+    default ``fleet.sync.DEFAULT_SYNC_TIMEOUT_S``), is journaled as
+    ``artifact-sync`` events, and a failed sync forfeits the lease --
+    the cell re-runs on another worker -- until the lease budget is
+    exhausted, at which point the verdict is kept (``synced: False``)
+    and ``--resume`` re-syncs instead of re-running.
+
+    **Chaos** (``chaos``): a ``fleet.chaos`` profile (or its
+    ``"name:seed"`` spec) wraps every worker transport in
+    `remotes.FaultyRemote` and schedules worker kill -9s, so the
+    lease/steal/sync machinery is exercised under seeded faults."""
     from ..analysis import planlint, render_text, errors as diag_errors
+    from . import sync as fsync
 
     workers = [w if isinstance(w, Worker) else Worker(w, w)
                for w in (workers or [])]
     cells = list(cells)
     base_options = dict(base_options or {})
+    if sync_timeout_s is None:
+        sync_timeout_s = fsync.DEFAULT_SYNC_TIMEOUT_S
+    if chaos is not None:
+        from . import chaos as fchaos
+        chaos = fchaos.parse(chaos)
     diags = planlint.lint_fleet({
         "workers": [w.id for w in workers],
         "lease-s": lease_s,
@@ -169,6 +195,13 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         "device-slots": device_slots,
         "backends": backends,
         "time-limit": base_options.get("time-limit"),
+    })
+    diags += planlint.lint_service({
+        "serve?": serve,
+        "serve-ip": serve_ip,
+        "auth-token?": bool(auth_token),
+        "sync-timeout-s": sync_timeout_s,
+        "lease-s": lease_s,
     })
     # PL015 rides along like PL013/PL014: the workers rebuild test
     # maps from these base options, so searchplan knob mistakes
@@ -208,6 +241,10 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         "cells": ids,
         "workers": [w.id for w in workers],
         "lease-s": lease_s,
+        "sync-timeout-s": sync_timeout_s,
+        **({"worker-store": str(worker_store_dir)}
+           if worker_store_dir else {}),
+        **({"chaos": chaos.describe()} if chaos is not None else {}),
         "resumes": ((prior or {}).get("resumes") or 0)
         + (1 if resume else 0),
     })
@@ -233,6 +270,24 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                         else "python3")
     cwd = cwd or _repo_root()
     store_dir = os.path.abspath(store.base_dir)
+    # where the WORKERS write runs: the coordinator's store by default
+    # (loopback workers share the filesystem), or worker_store_dir for
+    # isolated worker stores -- the topology real remote hosts have,
+    # reproducible on one machine, and the one artifact sync exists for
+    worker_store = os.path.abspath(worker_store_dir) \
+        if worker_store_dir else store_dir
+
+    def needs_sync(worker):
+        if sync is True:
+            return True
+        if sync is False:
+            return False
+        return worker.kind != "local" or worker_store != store_dir
+
+    kill_cells = chaos.plan_kills(ids) if chaos is not None else set()
+    if chaos is not None and chaos.torn_ledger_tail and led is not None:
+        from . import chaos as fchaos
+        fchaos.tear_ledger_tail(led)
 
     cond = threading.Condition()
     pending = collections.deque(c for c in cells if c["id"] not in done)
@@ -313,12 +368,71 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                 "params": cell.get("params") or {},
                 "options": base_options,
                 "builder": builder or "jepsen_tpu.demo:demo_test",
-                "store-dir": store_dir,
+                "store-dir": worker_store,
                 "worker": worker.id,
                 "ledger": bool(ledger)}
+        if cell["id"] in kill_cells:
+            # chaos-scheduled kill -9: the die-once marker makes the
+            # FIRST lease die mid-run and every later lease run clean
+            safe = str(cell["id"]).replace(os.sep, "_")
+            spec["die-once-marker"] = os.path.abspath(
+                store.campaign_path(campaign_id, f"chaos-kill-{safe}"))
         if backends is not None:
             spec["backend"] = backends.choose()
         return spec
+
+    def journal_sync(cell, wid, status, info=None, **extra):
+        """One ``artifact-sync`` event record + metric (the sync_rec
+        and resume-resync paths must journal identically)."""
+        reg.inc("fleet.artifact_syncs", status=status)
+        jr.append_event({"event": "artifact-sync", "cell": cell,
+                         "worker": wid, "status": status,
+                         **{k: info[k] for k in
+                            ("files", "bytes", "attempts", "wall_s")
+                            if info and k in info},
+                         **extra, "t": store.local_time()})
+
+    def sync_rec(worker, conn, lease, rec):
+        """Mirror the finished cell's run directory into the
+        coordinator store (fleet.sync): rewrites ``rec["path"]`` to
+        the coordinator-local copy and journals the outcome as an
+        ``artifact-sync`` event. Returns None on success (or nothing
+        to do), else the error string -- the caller decides whether
+        that forfeits the lease."""
+
+        def failed(err):
+            journal_sync(lease.unit, worker.id, "failed",
+                         error=str(err)[:300])
+            rec["synced"] = False
+            # journal how to reach this worker's store: a later
+            # --resume may run with a DIFFERENT worker list, and the
+            # worker id alone is not a resolvable address
+            rec["worker-kind"] = worker.kind
+            rec["worker-conn"] = dict(worker.conn_spec)
+            return str(err)
+
+        src = rec.get("path")
+        if not src:
+            return None          # crashed before the store existed
+        src = str(src)
+        rel = os.path.relpath(src, worker_store)
+        if rel.startswith(".."):
+            rec["worker-path"] = src
+            return failed(f"run path {src!r} escapes the worker store")
+        dest = os.path.join(store_dir, rel)
+        rec["path"] = dest
+        if worker.kind == "local" and os.path.abspath(src) == dest:
+            return None          # shared filesystem: already in place
+        rec["worker-path"] = src
+        try:
+            info = fsync.pull_run(conn, src, dest,
+                                  timeout_s=sync_timeout_s)
+        except Exception as exc:  # noqa: BLE001 - journaled, bounded
+            return failed(exc)
+        reg.observe("fleet.artifact_sync_s", info.get("wall_s") or 0.0)
+        journal_sync(lease.unit, worker.id, "ok", info=info, path=dest)
+        rec["synced"] = True
+        return None
 
     def run_lease(worker, conn, cell):
         cid = cell["id"]
@@ -348,12 +462,48 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         from .worker import parse_result
         rec = parse_result(res.get("out")) if res.get("exit") == 0 \
             else None
+        sync_err = None
+        if rec is not None and needs_sync(worker):
+            # hold the watchdog off during the download (best effort:
+            # if the lease already expired, the steal re-runs the cell
+            # into a FRESH run dir, so a late sync can't collide).
+            # Small pad past the pull's own deadline: the verify +
+            # rename + journal tail must not lose a lease race
+            table.extend(lease, sync_timeout_s + 5.0)
+            with tr.span("fleet.artifact_sync", cat="fleet",
+                         args={"cell": cid, "worker": worker.id}):
+                sync_err = sync_rec(worker, conn, lease, rec)
         current = table.release(lease)
         with cond:
             if rec is not None:
-                rec.setdefault("worker", worker.id)
-                rec["attempt"] = lease.attempt
-                ok = finish(cid, rec)
+                if sync_err is not None and current \
+                        and table.attempts(cid) < max_leases:
+                    # the run finished but its artifacts are stuck on
+                    # the worker: forfeit the lease so another worker
+                    # re-runs the cell (fresh artifacts, fresh sync)
+                    requeue_or_fail(cid, worker.id,
+                                    f"artifact sync failed: "
+                                    f"{sync_err}")
+                    ok = False
+                else:
+                    # lease budget exhausted with a sync failure: the
+                    # VERDICT is known (the worker reported it), so
+                    # keep it, mark the record unsynced, and let
+                    # --resume / web-on-demand fetch the artifacts
+                    # later instead of burning the run
+                    rec.setdefault("worker", worker.id)
+                    rec["attempt"] = lease.attempt
+                    ok = finish(cid, rec)
+                    if ok and rec.get("synced") is False \
+                            and rec.get("worker-path"):
+                        rel = os.path.relpath(str(rec["path"]),
+                                              store_dir)
+                        if not rel.startswith(".."):
+                            fsync.register_pending(
+                                rel, kind=worker.kind,
+                                conn_spec=worker.conn_spec,
+                                remote_dir=rec["worker-path"],
+                                timeout_s=sync_timeout_s)
             else:
                 err = (res.get("err") or "")[-300:] \
                     or f"exit {res.get('exit')}, no result line"
@@ -364,6 +514,13 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     def worker_loop(worker):
         try:
             conn = worker.connect()
+            if chaos is not None:
+                # the chaos schedule wraps the DISPATCH transport (cell
+                # execs + artifact sync); the liveness probe below runs
+                # on its own clean connection, so injection exercises
+                # recovery paths, not the admission gate
+                conn = remotes.FaultyRemote(
+                    conn, chaos.faults_for(worker.id))
         except Exception as exc:  # noqa: BLE001
             conn, exc_ = None, exc
         if probe and conn is not None:
@@ -420,8 +577,70 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                 alive.discard(worker.id)
                 cond.notify_all()
 
+    def resync_done_cells():
+        """--resume re-SYNCS instead of re-running: a terminal cell
+        whose record says ``synced: False`` kept its verdict but left
+        its artifacts on the worker; pull them now (clean transport,
+        no chaos) and journal the outcome. Already-mirrored runs (a
+        prior resume, or web's on-demand fetch) are left alone."""
+        by_worker = {w.id: w for w in workers}
+
+        def resync_one(cid, rec):
+            dest = str(rec.get("path") or "")
+            rel = os.path.relpath(dest, store_dir) if dest else ".."
+            if not dest or rel.startswith("..") or os.path.isdir(dest):
+                return
+            w = by_worker.get(str(rec.get("worker")))
+            if w is not None:
+                kind, conn_spec = w.kind, w.conn_spec
+            elif rec.get("worker-conn"):
+                # the worker isn't in THIS fleet's list, but its
+                # terminal record journaled how to reach it
+                kind = rec.get("worker-kind") or "ssh"
+                conn_spec = rec["worker-conn"]
+            else:
+                logger.warning("can't re-sync %s: worker %r isn't in "
+                               "this fleet and its record carries no "
+                               "conn spec", cid, rec.get("worker"))
+                return
+            wid = str(rec.get("worker"))
+            try:
+                base = fsync.resolve_remote(kind)
+                if base is None:
+                    raise FleetError(f"unknown worker kind {kind!r}")
+                info = fsync.pull_run(base().connect(conn_spec),
+                                      rec["worker-path"], dest,
+                                      timeout_s=sync_timeout_s)
+            except Exception as exc:  # noqa: BLE001 - per-cell
+                journal_sync(cid, wid, "failed",
+                             error=str(exc)[:300])
+                fsync.register_pending(rel, kind=kind,
+                                       conn_spec=conn_spec,
+                                       remote_dir=rec["worker-path"],
+                                       timeout_s=sync_timeout_s)
+                return
+            journal_sync(cid, wid, "ok", info=info, path=dest)
+
+        todo = [(cid, rec) for cid, rec in done.items()
+                if rec.get("synced") is False
+                and rec.get("worker-path")]
+        if not todo:
+            return
+        # re-syncs are independent of each other AND of dispatch;
+        # serial pulls would stall startup by up to sync_timeout_s
+        # per unreachable worker (journal appends are thread-safe --
+        # the worker threads share it the same way)
+        import concurrent.futures as cf
+        with cf.ThreadPoolExecutor(
+                max_workers=min(8, len(todo)),
+                thread_name_prefix="jepsen fleet resync") as pool:
+            for _ in pool.map(lambda a: resync_one(*a), todo):
+                pass
+
     if not workers:
         raise FleetError("fleet dispatch needs at least one worker")
+    if resume and done:
+        resync_done_cells()
     watchdog = robust.LeaseWatchdog(table, on_lease_expired,
                                     poll_s=min(1.0, lease_s / 4))
     hard_abort = None
@@ -458,12 +677,15 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                        "cell(s) unfinished", campaign_id,
                        len(unfinished))
 
-    # compile reuse: the coordinator itself compiles nothing -- sum the
-    # workers' own deltas from their records, then fold in the
-    # persisted ledger aggregate
+    # compile reuse: the coordinator itself compiles nothing -- sum
+    # THIS run's workers' deltas from their records (cells resumed
+    # from a prior process already reported theirs in that process's
+    # stats event; re-folding them would double-count on every
+    # --resume), then fold in the persisted ledger aggregate
     recs = jr.latest()
+    fresh = [r for r in recs if str(r.get("cell")) not in done]
     cc = {"hits": 0, "misses": 0}
-    for r in recs:
+    for r in fresh:
         w = r.get("compile-cache") or {}
         cc["hits"] += int(w.get("hits") or 0)
         cc["misses"] += int(w.get("misses") or 0)
@@ -473,7 +695,14 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     reg.set_gauge("campaign.compile_cache.hits", cc["hits"])
     reg.set_gauge("campaign.compile_cache.misses", cc["misses"])
     if led is not None:
-        led.note_stats(cc["hits"], cc["misses"])
+        # cold/warm compile wall: cells whose own delta had misses
+        # paid a compile (cold); all-hit cells rode the caches (warm).
+        # With the persistent jax compilation cache on, a restarted
+        # campaign's "cold" cells stop paying -- this is the evidence
+        from .ledger import fold_walls
+        cold, warm = fold_walls(fresh)
+        led.note_stats(cc["hits"], cc["misses"], cold_wall_s=cold,
+                       warm_wall_s=warm)
         try:
             cc = dict(cc, ledger=led.stats())
         except Exception:  # noqa: BLE001 - bookkeeping only

@@ -46,8 +46,9 @@ except ImportError:  # pragma: no cover - non-POSIX
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["LEDGER_FILE", "Ledger", "canon_key", "attach", "attached",
-           "detach"]
+__all__ = ["LEDGER_FILE", "JAX_CACHE_DIR", "Ledger", "canon_key",
+           "attach", "attached", "detach", "enable_jax_cache",
+           "fold_walls"]
 
 LEDGER_FILE = "ledger.jsonl"
 
@@ -153,14 +154,25 @@ class Ledger:
         with self._lock:
             self._keys.add(k)
 
-    def note_stats(self, hits, misses):
+    def note_stats(self, hits, misses, cold_wall_s=None,
+                   warm_wall_s=None):
         """Append one process's hit/miss delta as a stats event (the
         campaign scheduler calls this at finalize), so the persisted
-        ledger carries reuse evidence, not just shapes."""
+        ledger carries reuse evidence, not just shapes.
+
+        ``cold_wall_s``/``warm_wall_s`` fold the campaign's compile
+        wall clock in: total wall of cells that paid a compile (their
+        delta had misses) vs cells that rode the caches. Paired with
+        the persistent jax compilation cache (`enable_jax_cache`),
+        the cold number is what a warm restart should shrink."""
+        st = {"hits": int(hits), "misses": int(misses)}
+        if cold_wall_s is not None:
+            st["cold_wall_s"] = round(float(cold_wall_s), 3)
+        if warm_wall_s is not None:
+            st["warm_wall_s"] = round(float(warm_wall_s), 3)
         try:
-            self._append({"stats": {"hits": int(hits),
-                                    "misses": int(misses)},
-                          "pid": os.getpid(), "t": store.local_time()})
+            self._append({"stats": st, "pid": os.getpid(),
+                          "t": store.local_time()})
         except Exception:  # noqa: BLE001 - telemetry only
             logger.warning("compile-ledger stats append failed",
                            exc_info=True)
@@ -172,6 +184,7 @@ class Ledger:
         deltas across every process that ever reported, and the
         contributing pids."""
         shapes, hits, misses, pids = set(), 0, 0, set()
+        cold_s, warm_s = 0.0, 0.0
         try:
             with open(self.path, "rb") as f:
                 lines = f.read().split(b"\n")
@@ -196,23 +209,78 @@ class Ledger:
             if isinstance(st, dict):
                 hits += int(st.get("hits") or 0)
                 misses += int(st.get("misses") or 0)
+                cold_s += float(st.get("cold_wall_s") or 0)
+                warm_s += float(st.get("warm_wall_s") or 0)
             if rec.get("pid") is not None:
                 pids.add(rec["pid"])
         return {"path": self.path, "shapes": len(shapes),
                 "hits": hits, "misses": misses,
+                "cold_wall_s": round(cold_s, 3),
+                "warm_wall_s": round(warm_s, 3),
                 "processes": len(pids)}
 
 
-def attach(dir=None):  # noqa: A002 - mirrors Ledger
+def fold_walls(records):
+    """``(cold_wall_s, warm_wall_s)`` over campaign cell records: the
+    total wall of cells whose compile-cache delta had misses (they
+    paid a compile) vs all-hit cells. One definition, shared by the
+    scheduler and fleet finalize paths, so the ledger's cold/warm
+    evidence can't silently diverge between the two."""
+    cold = sum(float(r.get("wall_s") or 0) for r in records
+               if (r.get("compile-cache") or {}).get("misses"))
+    warm = sum(float(r.get("wall_s") or 0) for r in records
+               if r.get("compile-cache")
+               and not r["compile-cache"].get("misses"))
+    return cold, warm
+
+
+JAX_CACHE_DIR = "jax_cache"
+
+
+def enable_jax_cache(cache_dir=None):
+    """Point jax's persistent compilation cache at a per-store
+    directory (``store/compile_ledger/jax_cache/`` by default), so
+    the COMPILES survive process restarts -- the ledger alone only
+    makes the hit accounting survive; a restarted campaign still paid
+    every XLA compile again. Returns the cache dir, or None when jax
+    (or this jax version's knob) isn't available; never raises --
+    compile caching is an optimization, not a dependency."""
+    path = os.path.abspath(cache_dir
+                           or store.compile_ledger_path(JAX_CACHE_DIR))
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+        if getattr(jax.config, "jax_compilation_cache_dir", None) \
+                != path:
+            jax.config.update("jax_compilation_cache_dir", path)
+            # small searches compile in well under the 60s default
+            # floor; 1s keeps sweep-sized kernels cacheable without
+            # persisting every trivial jit
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1)
+        return path
+    except Exception:  # noqa: BLE001 - optimization only
+        logger.warning("couldn't enable the persistent jax "
+                       "compilation cache", exc_info=True)
+        return None
+
+
+def attach(dir=None, jax_cache=True):  # noqa: A002 - mirrors Ledger
     """Attach a persistent ledger to ``campaign.compile_cache`` (the
     note() path consults it from then on) and seed the in-memory seen
     set from disk, so shapes compiled by earlier/concurrent processes
     count as hits immediately. Idempotent per directory: re-attaching
     the same directory reuses the live handle (nested campaign runs in
-    one process must not reset each other's offsets)."""
+    one process must not reset each other's offsets).
+
+    ``jax_cache=True`` also points jax's persistent compilation cache
+    at a sibling directory (`enable_jax_cache`): ledger and compile
+    artifacts restart together."""
     from ..campaign import compile_cache
     led = compile_cache.get_ledger()
     target = os.path.abspath(dir or store.compile_ledger_path())
+    if jax_cache:
+        enable_jax_cache(os.path.join(target, JAX_CACHE_DIR))
     if led is not None and led.dir == target:
         return led
     led = Ledger(target)

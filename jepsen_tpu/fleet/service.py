@@ -20,6 +20,15 @@ external traffic can POST work instead of running the harness locally:
   stopping the service gracefully aborts (and leaves resumable) every
   campaign it accepted.
 
+* **Admission control.** The service used to trust its callers; now
+  every request passes the `Admission` gate first: token authn
+  (constant-time compare; planlint PL016 makes a non-loopback bind
+  without a token a preflight error), per-caller budgets (concurrent
+  checks, queued campaigns, ops/day) with a bounded admission queue
+  that sheds load as 429 + Retry-After instead of wedging, and a
+  graceful drain on shutdown. Rejected or shed requests never touch
+  in-flight work -- a 429 is bookkeeping, not an abort.
+
 Transport-level hardening (size limits, JSON errors) lives in
 web.Handler; this module is pure request logic so it tests without a
 socket.
@@ -27,6 +36,8 @@ socket.
 
 from __future__ import annotations
 
+import contextlib
+import hmac
 import logging
 import re
 import threading
@@ -36,9 +47,10 @@ from .. import robust, store
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["MAX_BODY_BYTES", "ApiError", "check_history",
-           "submit_campaign", "campaign_status", "latch", "shutdown",
-           "reset"]
+__all__ = ["MAX_BODY_BYTES", "ApiError", "Admission",
+           "DEFAULT_BUDGETS", "authorize", "admission", "configure",
+           "check_history", "submit_campaign", "campaign_status",
+           "latch", "drain", "shutdown", "reset"]
 
 #: request-body ceiling enforced by web.Handler BEFORE reading
 MAX_BODY_BYTES = 16 << 20
@@ -54,17 +66,269 @@ MAX_CHECK_OPS = 200_000
 
 
 class ApiError(Exception):
-    """An HTTP-shaped request failure."""
+    """An HTTP-shaped request failure. ``retry_after`` (seconds)
+    becomes a ``Retry-After`` response header -- shed load tells the
+    caller when to come back instead of just slamming the door."""
 
-    def __init__(self, status, message, **extra):
+    def __init__(self, status, message, retry_after=None, headers=None,
+                 **extra):
         self.status = int(status)
         self.payload = {"error": str(message), **extra}
+        self.headers = dict(headers or {})
+        if retry_after is not None:
+            self.headers["Retry-After"] = str(max(1, int(retry_after)))
         super().__init__(str(message))
+
+
+# ---------------------------------------------------------------------------
+# admission control: authn + per-caller budgets + bounded queue
+
+#: default per-caller budgets. Generous on purpose: a bare viewer on
+#: loopback should behave exactly as before; real deployments tighten
+#: them via `configure`. ``ops-per-day`` is off (None) by default.
+DEFAULT_BUDGETS = {
+    "concurrent-checks": 8,   # in-flight /api/check per caller
+    "queue-depth": 16,        # callers allowed to WAIT for a slot
+    "campaigns": 8,           # queued+running campaigns per caller
+    "ops-per-day": None,      # history events accepted per caller/day
+}
+
+
+class Admission:
+    """The front door: who may ask, and how much.
+
+    * **Authn.** With tokens configured, every request needs
+      ``Authorization: Bearer <token>``; comparison is constant-time
+      (`hmac.compare_digest`) so the token can't be sniffed out a
+      byte at a time. Without tokens the caller is identified by its
+      client address (budgets still apply).
+    * **Budgets.** Per caller: at most ``concurrent-checks`` checks in
+      flight; up to ``queue-depth`` more may wait (bounded, with a
+      wall deadline) and everything past that sheds immediately as
+      429 + Retry-After -- the queue is how bursts smooth out, the
+      shed is how overload stays an error instead of a wedge.
+      ``campaigns`` bounds queued+running submissions; ``ops-per-day``
+      is a daily work quota (the check is NP-hard: accepted ops ARE
+      the cost).
+    * **Drain.** ``drain()`` stops new admissions (503) and wakes
+      waiters; in-flight requests and accepted campaigns are
+      untouched -- shutdown gets to be graceful because rejection
+      never reaches into running work.
+    """
+
+    def __init__(self, token=None, tokens=None, budgets=None,
+                 queue_wait_s=15.0):
+        self.tokens = {str(t): str(n) for t, n in (tokens or {}).items()}
+        if token:
+            self.tokens.setdefault(str(token), "token")
+        self.budgets = dict(DEFAULT_BUDGETS)
+        self.budgets.update(budgets or {})
+        for k in ("concurrent-checks", "queue-depth", "campaigns",
+                  "ops-per-day"):
+            v = self.budgets.get(k)
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v < 0):
+                raise ValueError(f"budget {k!r} must be a "
+                                 f"non-negative integer, got {v!r}")
+        self.queue_wait_s = float(queue_wait_s)
+        self._cond = threading.Condition()
+        self._draining = False
+        self._callers = {}
+
+    def _state(self, caller):
+        return self._callers.setdefault(str(caller), {
+            "active": 0, "waiting": 0, "day": None, "ops": 0,
+            "campaigns": 0})
+
+    def _gc(self, caller):
+        """Drop an idle caller's state (lock held). Unauthenticated
+        callers are keyed by client address, so without this the
+        table grows by one entry per distinct source IP forever --
+        a slow leak anyone with rotating addresses could drive on
+        purpose. Kept only while something is actually held: a slot
+        in flight, a waiter, a live campaign, or today's op spend."""
+        caller = str(caller)
+        st = self._callers.get(caller)
+        if st is None or st["active"] or st["waiting"] \
+                or st["campaigns"]:
+            return
+        if self.budgets.get("ops-per-day") is not None and st["ops"] \
+                and st["day"] == int(time.time() // 86400):
+            return
+        self._callers.pop(caller, None)
+
+    # -- authn ----------------------------------------------------------
+
+    def authorize(self, header=None, client="local"):
+        """The caller id for one request, or 401. ``header`` is the
+        raw Authorization value (``Bearer <token>`` or the bare
+        token); ``client`` identifies unauthenticated callers when no
+        token is required."""
+        if not self.tokens:
+            return str(client or "local")
+        tok = str(header or "")
+        if tok.lower().startswith("bearer "):
+            tok = tok[len("bearer "):].strip()
+        matched = None
+        for t, name in self.tokens.items():
+            # compare EVERY configured token: the loop's timing must
+            # not reveal which (if any) prefix-matched
+            if hmac.compare_digest(tok.encode(), t.encode()):
+                matched = name
+        if matched is None:
+            raise ApiError(401, "missing or invalid API token",
+                           headers={"WWW-Authenticate": "Bearer"})
+        return matched
+
+    # -- checks ---------------------------------------------------------
+
+    @contextlib.contextmanager
+    def check_slot(self, caller, ops=0):
+        """Hold one concurrent-check slot for ``caller`` (queueing up
+        to the budget, shedding past it); charges ``ops`` against the
+        daily quota on admission."""
+        self._admit(str(caller), int(ops))
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._state(caller)["active"] -= 1
+                self._gc(caller)
+                self._cond.notify_all()
+
+    def _admit(self, caller, ops):
+        deadline = time.monotonic() + self.queue_wait_s
+        with self._cond:
+            st = self._state(caller)
+            quota = self.budgets.get("ops-per-day")
+
+            def check_quota():
+                if quota is None:
+                    return
+                day = int(time.time() // 86400)
+                if st["day"] != day:
+                    st["day"], st["ops"] = day, 0
+                if st["ops"] + ops > quota:
+                    nxt = (day + 1) * 86400 - time.time()
+                    raise ApiError(
+                        429, f"daily op quota exhausted "
+                             f"({st['ops']}/{quota} used, "
+                             f"{ops} requested)",
+                        retry_after=min(86400, max(1, nxt)))
+
+            check_quota()
+            # a None budget means unlimited, for every key -- the
+            # validator admits None, so the checks must too
+            limit = self.budgets["concurrent-checks"]
+            qdepth = self.budgets["queue-depth"]
+            while not self._draining and limit is not None \
+                    and st["active"] >= limit:
+                left = deadline - time.monotonic()
+                if (qdepth is not None and st["waiting"] >= qdepth) \
+                        or left <= 0:
+                    raise ApiError(
+                        429, "concurrent check budget exhausted "
+                             f"({st['active']} in flight, "
+                             f"{st['waiting']} queued)",
+                        retry_after=2)
+                st["waiting"] += 1
+                try:
+                    self._cond.wait(timeout=left)
+                finally:
+                    st["waiting"] -= 1
+            if self._draining:
+                raise ApiError(503, "service is draining",
+                               retry_after=30)
+            # cond.wait released the lock, so sibling waiters may
+            # have spent the quota meanwhile: re-check before charging
+            check_quota()
+            st["active"] += 1
+            if quota is not None:
+                st["ops"] += ops
+
+    # -- campaigns ------------------------------------------------------
+
+    def campaign_slot(self, caller):
+        """Claim one campaign slot (released via `campaign_done` when
+        the campaign thread finishes); 429 past the budget."""
+        with self._cond:
+            if self._draining:
+                raise ApiError(503, "service is draining",
+                               retry_after=30)
+            st = self._state(caller)
+            limit = self.budgets["campaigns"]
+            if limit is not None and st["campaigns"] >= limit:
+                raise ApiError(
+                    429, f"campaign budget exhausted ({limit} "
+                         "queued or running)", retry_after=30)
+            st["campaigns"] += 1
+
+    def campaign_done(self, caller):
+        with self._cond:
+            st = self._state(caller)
+            st["campaigns"] = max(0, st["campaigns"] - 1)
+            self._gc(caller)
+            self._cond.notify_all()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def drain(self):
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    @property
+    def draining(self):
+        with self._cond:
+            return self._draining
+
+    def snapshot(self):
+        """Per-caller counters (status pages, tests)."""
+        with self._cond:
+            return {c: dict(st) for c, st in self._callers.items()}
 
 
 _lock = threading.Lock()
 _latch = None
+_admission = None
 _campaigns = {}     # campaign id -> {"thread", "latch", "submitted"}
+
+
+def configure(token=None, tokens=None, budgets=None,
+              queue_wait_s=15.0):
+    """(Re)build the service-wide admission gate: the --serve /
+    web.serve entry points call this with the operator's token and
+    budget knobs. Replacing the gate only affects NEW requests;
+    in-flight slots release against the old one harmlessly (its
+    counters die with it)."""
+    global _admission
+    gate = Admission(token=token, tokens=tokens, budgets=budgets,
+                     queue_wait_s=queue_wait_s)
+    with _lock:
+        _admission = gate
+    return gate
+
+
+def admission():
+    """The service-wide Admission gate (permissive defaults until
+    `configure` is called: no tokens, generous budgets)."""
+    global _admission
+    with _lock:
+        if _admission is None:
+            _admission = Admission()
+        return _admission
+
+
+def authorize(header=None, client="local"):
+    """Module-level convenience: the caller id for one request, or
+    401 (web.Handler calls this before routing)."""
+    return admission().authorize(header, client=client)
+
+
+def drain():
+    """Stop admitting new requests (503 + Retry-After); in-flight
+    requests and accepted campaigns keep running."""
+    admission().drain()
 
 
 def latch():
@@ -77,9 +341,11 @@ def latch():
 
 
 def shutdown(reason="service-shutdown", join_s=10.0):
-    """Honor the shared AbortLatch: flip it so every accepted campaign
-    aborts gracefully (journals stay resumable), then give their
-    threads a bounded join."""
+    """Graceful stop: drain admission first (new requests shed as
+    503, waiters wake), then honor the shared AbortLatch so every
+    accepted campaign aborts gracefully (journals stay resumable),
+    then give their threads a bounded join."""
+    drain()
     latch().set(reason)
     with _lock:
         threads = [c["thread"] for c in _campaigns.values()]
@@ -90,9 +356,10 @@ def shutdown(reason="service-shutdown", join_s=10.0):
 
 def reset():
     """Forget service state (tests)."""
-    global _latch
+    global _latch, _admission
     with _lock:
         _latch = None
+        _admission = None
         _campaigns.clear()
 
 
@@ -130,23 +397,32 @@ def _split_keyed(hist):
     return {k: independent.subhistory(k, hist) for k in keys}
 
 
-def check_history(payload):
+def check_history(payload, caller="local"):
     """The /api/check pipeline; returns the response dict or raises
     ApiError. Payload keys: ``history`` (list of op maps, required),
     ``model`` (name, default cas-register), ``engine`` (jax-wgl /
     linear / wgl, default jax-wgl), ``keyed`` (bool), ``init-ops``,
-    ``timeout-s``."""
-    from ..analysis import histlint, errors as diag_errors
-    from ..checker.checkers import Linearizable
-    from ..models import model_spec
-    from ..monitor import engine as mengine
-
+    ``timeout-s``. ``caller`` is the `authorize`-d identity the
+    admission gate budgets against."""
     if not isinstance(payload, dict):
         raise ApiError(400, "request body must be a JSON object")
     hist = _require(payload, "history", list, "a list of op maps")
     if len(hist) > MAX_CHECK_OPS:
         raise ApiError(413, f"history has {len(hist)} events; this "
                             f"service accepts at most {MAX_CHECK_OPS}")
+    # admission: one concurrent-check slot per caller for the whole
+    # pipeline (the check is NP-hard; accepted events ARE the cost, so
+    # the history length is what the daily quota charges)
+    with admission().check_slot(caller, ops=len(hist)):
+        return _check_admitted(payload, hist)
+
+
+def _check_admitted(payload, hist):
+    from ..analysis import histlint, errors as diag_errors
+    from ..checker.checkers import Linearizable
+    from ..models import model_spec
+    from ..monitor import engine as mengine
+
     model = payload.get("model", "cas-register")
     try:
         spec = model_spec(str(model))
@@ -289,10 +565,12 @@ def _safe_campaign_id(cid):
     return cid
 
 
-def submit_campaign(payload, builder=None):
+def submit_campaign(payload, builder=None, caller="local"):
     """Accept a sweep matrix; returns (campaign_id, meta dict). The
     campaign runs on a daemon thread via the ordinary scheduler with a
-    latch chained off the service latch."""
+    latch chained off the service latch. ``caller`` is the
+    `authorize`-d identity whose campaign budget the submission
+    claims (released when the campaign thread finishes)."""
     from ..campaign import plan as cplan
     from ..campaign import run_cells, CampaignError
 
@@ -349,6 +627,10 @@ def submit_campaign(payload, builder=None):
               "params": c["params"], "build": build}
              for c in cells_plan]
     child = robust.ChainedLatch(parent=latch())
+    # claim the caller's campaign-budget slot LAST, after every 4xx
+    # has had its chance: a rejected payload must not burn budget
+    adm = admission()
+    adm.campaign_slot(caller)
 
     def run():
         try:
@@ -361,13 +643,19 @@ def submit_campaign(payload, builder=None):
         except Exception:  # noqa: BLE001 - background thread
             logger.warning("submitted campaign %s crashed",
                            campaign_id, exc_info=True)
+        finally:
+            adm.campaign_done(caller)
 
-    t = threading.Thread(target=run, daemon=True,
-                         name=f"jepsen api campaign {campaign_id}")
-    with _lock:
-        _campaigns[campaign_id] = {"thread": t, "latch": child,
-                                   "submitted": store.local_time()}
-    t.start()
+    try:
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"jepsen api campaign {campaign_id}")
+        with _lock:
+            _campaigns[campaign_id] = {"thread": t, "latch": child,
+                                       "submitted": store.local_time()}
+        t.start()
+    except BaseException:   # thread never ran: give the slot back
+        adm.campaign_done(caller)
+        raise
     from .. import obs
     obs.inc("fleet.api_campaigns")
     return campaign_id, {"campaign": campaign_id,

@@ -68,6 +68,20 @@ class LeaseTable:
             self._leases[unit] = lease
             return lease
 
+    def extend(self, lease, ttl_s):
+        """Push a lease's deadline to ``now + ttl_s`` IF it is still
+        the current grant (returns whether it was). The fleet
+        dispatcher extends a lease while it syncs the worker's run
+        artifacts: the worker already finished, but the watchdog must
+        not steal the cell out from under a slow download."""
+        now = time.monotonic()
+        with self._lock:
+            if self._leases.get(lease.unit) is lease:
+                lease.deadline = now + float(ttl_s)
+                lease.ttl_s = float(ttl_s)
+                return True
+            return False
+
     def release(self, lease):
         """Drop a lease IF it is still the current grant for its unit
         (returns whether it was)."""

@@ -45,6 +45,19 @@ class RetryPolicy:
     max_backoff_s: float = 5.0      #: per-sleep cap
     max_elapsed_s: float | None = None  #: total budget; None = unbounded
 
+    @classmethod
+    def bounded(cls, total_s, tries=4, base_s=0.5):
+        """A policy whose whole loop (attempts + sleeps) fits inside
+        ``total_s``: the shape callers with a hard wall budget want
+        (e.g. fleet artifact sync, whose budget must stay under the
+        worker lease). Per-sleep cap scales with the budget so a
+        short budget doesn't spend itself sleeping."""
+        total_s = max(0.1, float(total_s))
+        return cls(tries=max(1, int(tries)), base_s=float(base_s),
+                   multiplier=2.0, jitter=0.1,
+                   max_backoff_s=max(float(base_s), total_s / 8.0),
+                   max_elapsed_s=total_s)
+
     def backoff_s(self, attempt, rng=random):
         """Sleep before retry number ``attempt`` (0-based: the sleep
         between attempt 0 and attempt 1 is ``backoff_s(0)``)."""

@@ -58,6 +58,12 @@ CAMPAIGNS_DIR = "campaigns"
 #: jepsen_tpu.fleet.ledger); reserved -- test_names() skips it
 COMPILE_LEDGER_DIR = "compile_ledger"
 
+#: directory under base_dir where fleet artifact sync stages
+#: downloads before their atomic rename into place
+#: (jepsen_tpu.fleet.sync); reserved -- test_names() skips it, and
+#: anything inside is by definition an unpublished partial copy
+SYNC_TMP_DIR = ".sync-tmp"
+
 TIME_FORMAT = "%Y%m%dT%H%M%S.%f%z"
 
 
@@ -414,6 +420,15 @@ def compile_ledger_path(*args):
     return os.path.join(base_dir, COMPILE_LEDGER_DIR, *map(str, args))
 
 
+def sync_tmp_path(*args):
+    """The artifact-sync staging area (or a path inside it):
+    ``base_dir/.sync-tmp/...`` (jepsen_tpu.fleet.sync). Same
+    filesystem as the runs it stages for, so the publishing rename is
+    atomic."""
+    return os.path.join(os.path.abspath(base_dir), SYNC_TMP_DIR,
+                        *map(str, args))
+
+
 def campaigns():
     """All campaign ids in the store (those with a campaign.json)."""
     root = os.path.join(base_dir, CAMPAIGNS_DIR)
@@ -520,7 +535,7 @@ def test_names():
             if os.path.isdir(os.path.join(base_dir, d))
             and not os.path.islink(os.path.join(base_dir, d))
             and d not in ("latest", "current", CAMPAIGNS_DIR,
-                          COMPILE_LEDGER_DIR))
+                          COMPILE_LEDGER_DIR, SYNC_TMP_DIR))
     except FileNotFoundError:
         return []
 

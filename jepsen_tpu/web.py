@@ -238,18 +238,22 @@ class Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet
         logger.debug("web: " + fmt, *args)
 
-    def _send(self, code, body, ctype="text/html; charset=utf-8"):
+    def _send(self, code, body, ctype="text/html; charset=utf-8",
+              headers=None):
         if isinstance(body, str):
             body = body.encode()
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, code, obj):
+    def _send_json(self, code, obj, headers=None):
         return self._send(code, json.dumps(obj, cls=store._Encoder),
-                          "application/json; charset=utf-8")
+                          "application/json; charset=utf-8",
+                          headers=headers)
 
     def _read_json_body(self):
         """Bounded request-body read: the declared Content-Length is
@@ -279,21 +283,56 @@ class Handler(BaseHTTPRequestHandler):
             raise ApiError(400, "request body is not valid JSON") \
                 from None
 
-    def _api(self, method, path):
-        """The /api/* routes: JSON in, JSON out, JSON errors."""
+    def _caller(self):
+        """Authorize this request, whatever the route. With tokens
+        configured the token may arrive as ``Authorization: Bearer``
+        or ``?token=`` (browsers can't set headers); without tokens
+        the client address identifies the caller. Raises
+        service.ApiError(401) on a bad/missing token."""
+        from .fleet import service
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+        header = self.headers.get("Authorization") \
+            or (q.get("token") or [None])[0]
+        return service.authorize(
+            header, client=(self.client_address or ("local",))[0])
+
+    def _gate_html(self):
+        """Authn for the HTML/file routes: the store's histories and
+        verdicts (and the on-demand scp pull a /files miss can
+        trigger) are exactly what the token protects, so a token-
+        configured service gates EVERY route, not just /api. Sends
+        the error response and returns True when the request is
+        rejected (the caller must STOP -- writing the page after the
+        401 would leak it on the same socket), else False."""
         from .fleet import service
         try:
+            self._caller()
+            return False
+        except service.ApiError as e:
+            self._send_json(e.status, e.payload, headers=e.headers)
+            return True
+
+    def _api(self, method, path):
+        """The /api/* routes: JSON in, JSON out, JSON errors. Every
+        route passes the admission gate first -- token authn (401),
+        then per-caller budgets (429 + Retry-After) -- so rejected
+        traffic never reaches the request logic, let alone in-flight
+        campaigns."""
+        from .fleet import service
+        try:
+            caller = self._caller()
             clean = path.rstrip("/")
             if clean == "/api/check":
                 if method != "POST":
                     raise service.ApiError(
                         405, "POST a {'history': [...]} body here")
                 return self._send_json(
-                    200, service.check_history(self._read_json_body()))
+                    200, service.check_history(self._read_json_body(),
+                                               caller=caller))
             if clean == "/api/campaigns":
                 if method == "POST":
                     _cid, meta = service.submit_campaign(
-                        self._read_json_body())
+                        self._read_json_body(), caller=caller)
                     return self._send_json(202, meta)
                 if method != "GET":
                     raise service.ApiError(405, "GET or POST only")
@@ -307,7 +346,8 @@ class Handler(BaseHTTPRequestHandler):
                                        service.campaign_status(cid))
             raise service.ApiError(404, f"unknown API route {path!r}")
         except service.ApiError as e:
-            return self._send_json(e.status, e.payload)
+            return self._send_json(e.status, e.payload,
+                                   headers=e.headers)
         except BrokenPipeError:
             pass
         except Exception:  # noqa: BLE001
@@ -339,6 +379,8 @@ class Handler(BaseHTTPRequestHandler):
                 urllib.parse.urlparse(self.path).path)
             if path.startswith("/api/"):
                 return self._api("GET", path)
+            if self._gate_html():
+                return None
             if path in ("", "/"):
                 return self._send(200, _home_page())
             if path.rstrip("/") == "/campaigns":
@@ -365,7 +407,15 @@ class Handler(BaseHTTPRequestHandler):
         if not (full == base or full.startswith(base + os.sep)):
             return self._send(403, "<h1>403</h1>")
         if not os.path.exists(full):
-            return self._send(404, "<h1>404</h1>")
+            # download on demand: a remote cell whose artifact sync
+            # failed terminally registered its run with fleet.sync --
+            # pull it now so the run link resolves the moment the
+            # worker host is reachable again (cheap no-op otherwise)
+            from .fleet import sync as fsync
+            if not (fsync.pending()
+                    and fsync.fetch_on_demand(rel.strip("/"))
+                    and os.path.exists(full)):
+                return self._send(404, "<h1>404</h1>")
         if want_zip and os.path.isdir(full):
             return self._send(200, _zip_dir(full), "application/zip")
         if os.path.isdir(full):
@@ -391,8 +441,17 @@ class Handler(BaseHTTPRequestHandler):
 
 def serve(opts=None):
     """Starts the server; returns it (web.clj:361-366). Options: ip
-    (default 0.0.0.0), port (default 8080)."""
+    (default 0.0.0.0), port (default 8080), plus the admission knobs
+    -- token (Bearer token /api requests must present), budgets (a
+    service.DEFAULT_BUDGETS overlay), queue-wait-s -- which configure
+    the service gate before the socket opens."""
     opts = opts or {}
+    if opts.get("token") or opts.get("budgets") \
+            or opts.get("queue-wait-s"):
+        from .fleet import service
+        service.configure(
+            token=opts.get("token"), budgets=opts.get("budgets"),
+            queue_wait_s=opts.get("queue-wait-s") or 15.0)
     addr = (opts.get("ip", "0.0.0.0"), opts.get("port", 8080))
     server = ThreadingHTTPServer(addr, Handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True,

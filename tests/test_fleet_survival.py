@@ -1,0 +1,951 @@
+"""PR 9 fleet-survivability tests: crash-consistent artifact sync
+over the control plane (fleet/sync.py), the seeded chaos profiles +
+FaultyRemote fault injection (fleet/chaos.py, control/remotes.py),
+service admission control (authn, budgets, shed, drain), planlint
+PL016, the persistent jax compilation cache pairing, and the
+chaos-soak acceptance run (every cell terminal exactly once, all
+artifacts mirrored, 401/429 never disturbing in-flight work)."""
+
+import contextlib
+import json
+import os
+import shlex
+import signal
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import robust, store, web
+from jepsen_tpu.analysis import planlint
+from jepsen_tpu.campaign import compile_cache, plan
+from jepsen_tpu.campaign.journal import CampaignJournal
+from jepsen_tpu.control import remotes
+from jepsen_tpu.fleet import chaos as fchaos
+from jepsen_tpu.fleet import dispatch, ledger as fledger, service
+from jepsen_tpu.fleet import sync as fsync
+from jepsen_tpu.robust import RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+    compile_cache.reset()
+    service.reset()
+    fsync.clear_pending()
+    yield
+    compile_cache.reset()
+    service.reset()
+    fsync.clear_pending()
+
+
+def _local_conn():
+    return remotes.LocalRemote().connect({"host": "local"})
+
+
+def _seed_run_dir(root, name="demo-noop/20260101T000000.000000+0000"):
+    """A fake completed run directory with a few artifacts."""
+    d = os.path.join(str(root), name)
+    os.makedirs(d)
+    with open(os.path.join(d, "results.json"), "w") as f:
+        json.dump({"valid": True}, f)
+    with open(os.path.join(d, "history.jsonl"), "w") as f:
+        f.write('{"type": "invoke"}\n' * 200)
+    with open(os.path.join(d, "jepsen.log"), "w") as f:
+        f.write("fin\n")
+    return d, name
+
+
+# ---------------------------------------------------------------------------
+# robust primitives: bounded retry policy, lease extension
+
+
+def test_retry_policy_bounded_fits_the_budget():
+    p = RetryPolicy.bounded(2.0)
+    assert p.max_elapsed_s == 2.0
+    assert p.tries >= 1
+    t0 = time.monotonic()
+    with pytest.raises(ValueError):
+        p.call(lambda: (_ for _ in ()).throw(ValueError("nope")),
+               retry_on_exception=ValueError, site="test.bounded")
+    assert time.monotonic() - t0 < 4.0
+    # degenerate budgets still give a usable policy
+    assert RetryPolicy.bounded(0).max_elapsed_s > 0
+    assert RetryPolicy.bounded(60, tries=0).tries == 1
+
+
+def test_lease_extend_current_and_stale():
+    table = robust.LeaseTable()
+    lease = table.grant("cell", "w1", 1.0)
+    old_deadline = lease.deadline
+    assert table.extend(lease, 30.0) is True
+    assert lease.deadline > old_deadline
+    assert lease.ttl_s == 30.0
+    # a superseding grant makes the old lease stale: extending it
+    # must NOT touch the new holder's clock
+    lease2 = table.grant("cell", "w2", 1.0)
+    assert table.extend(lease, 99.0) is False
+    assert table.release(lease2) is True
+
+
+# ---------------------------------------------------------------------------
+# chaos profiles: parsing, determinism, caps
+
+
+def test_chaos_parse_specs():
+    assert fchaos.parse(None) is None
+    p = fchaos.parse("soak")
+    assert p.name == "soak" and p.seed == 0
+    p = fchaos.parse("soak:42")
+    assert p.seed == 42
+    assert fchaos.parse(p) is p
+    with pytest.raises(ValueError, match="unknown chaos profile"):
+        fchaos.parse("cyclone")
+    with pytest.raises(ValueError, match="seed"):
+        fchaos.parse("soak:abc")
+
+
+def test_chaos_schedule_is_deterministic_per_worker():
+    prof = fchaos.PROFILES["soak"].with_seed(7)
+
+    def schedule(worker, n=60):
+        faults = prof.faults_for(worker)
+        return [faults("execute") for _ in range(n)] + \
+               [faults("download") for _ in range(n)]
+
+    assert schedule("w1") == schedule("w1")
+    # at least one injected fault, and caps respected per worker
+    seq = schedule("w1")
+    injected = [f for f in seq if f is not None]
+    assert injected
+    assert sum(1 for f in seq if f == "exit-255") \
+        <= prof.exec_exit255_max
+    assert sum(1 for f in seq
+               if isinstance(f, tuple) and f[0] == "hang") \
+        <= prof.hang_max
+    assert sum(1 for f in seq if f == "partial") \
+        <= prof.download_partial_max
+
+
+def test_chaos_plan_kills_deterministic_and_capped():
+    prof = fchaos.ChaosProfile(name="k", seed=3, kills=2)
+    cells = [f"c{i}" for i in range(5)]
+    k1 = prof.plan_kills(cells)
+    assert k1 == prof.plan_kills(list(reversed(cells)))
+    assert len(k1) == 2 and k1 <= set(cells)
+    assert fchaos.ChaosProfile(kills=0).plan_kills(cells) == set()
+    # more kills than cells: capped, not an error
+    assert len(fchaos.ChaosProfile(seed=1, kills=99)
+               .plan_kills(cells)) == 5
+
+
+def test_faulty_remote_exec_faults():
+    seq = iter(["exit-255", None, "timeout"])
+    conn = remotes.FaultyRemote(
+        _local_conn(), lambda kind: next(seq, None))
+    r = conn.execute({}, {"cmd": "echo hi"})
+    assert r["exit"] == 255
+    assert remotes.transport_failed(r)
+    r = conn.execute({}, {"cmd": "echo hi"})
+    assert r["exit"] == 0 and r["out"].strip() == "hi"
+    r = conn.execute({}, {"cmd": "echo hi"})
+    assert r["exit"] == -1 and r["err"] == "timeout"
+
+
+def test_faulty_remote_hang_is_bounded_by_ctx_timeout():
+    conn = remotes.FaultyRemote(
+        _local_conn(), lambda kind: ("hang", 30.0))
+    t0 = time.monotonic()
+    r = conn.execute({"timeout": 0.2}, {"cmd": "echo hi"})
+    assert time.monotonic() - t0 < 5.0
+    assert r["exit"] == -1 and r["err"] == "timeout"
+
+
+def test_faulty_remote_partial_download_truncates_largest(tmp_path):
+    src, _ = _seed_run_dir(tmp_path / "remote")
+    faults = iter(["partial"])
+    conn = remotes.FaultyRemote(
+        _local_conn(), lambda kind: next(faults, None))
+    dest = str(tmp_path / "copy")
+    r = conn.download({}, src, dest)
+    assert r["exit"] == 0          # the torn copy REPORTS success
+    got = os.path.getsize(os.path.join(dest, "history.jsonl"))
+    want = os.path.getsize(os.path.join(src, "history.jsonl"))
+    assert got == want // 2
+
+
+# ---------------------------------------------------------------------------
+# artifact sync: manifest, atomicity, partial detection, on-demand
+
+
+def test_manifest_lists_files_and_rejects_empty(tmp_path):
+    src, _ = _seed_run_dir(tmp_path / "remote")
+    man = fsync.manifest(_local_conn(), src)
+    assert set(man) == {"results.json", "history.jsonl", "jepsen.log"}
+    assert man["history.jsonl"] == os.path.getsize(
+        os.path.join(src, "history.jsonl"))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(fsync.SyncError, match="empty manifest"):
+        fsync.manifest(_local_conn(), str(empty))
+
+
+def test_pull_run_mirrors_atomically(tmp_path):
+    src, name = _seed_run_dir(tmp_path / "remote")
+    dest = store.path({"name": "demo-noop",
+                       "start-time": name.split("/")[1]})
+    info = fsync.pull_run(_local_conn(), src, dest)
+    assert info["files"] == 3 and info["attempts"] == 1
+    assert os.path.isdir(dest)
+    assert json.load(open(os.path.join(dest, "results.json")))["valid"]
+    # idempotent: an existing mirror short-circuits
+    again = fsync.pull_run(_local_conn(), src, dest)
+    assert again.get("already") is True
+    # no staging litter
+    assert not os.path.isdir(store.sync_tmp_path()) \
+        or not os.listdir(store.sync_tmp_path())
+
+
+def test_pull_run_detects_partial_and_retries(tmp_path):
+    """The crash-consistency core: a torn copy that reports success
+    is caught by manifest verification and retried, and the partial
+    copy is NEVER visible at the destination."""
+    src, _ = _seed_run_dir(tmp_path / "remote")
+    faults = iter(["partial"])
+    conn = remotes.FaultyRemote(
+        _local_conn(), lambda kind: next(faults, None))
+    dest = str(tmp_path / "store" / "demo-noop" / "t1")
+    info = fsync.pull_run(conn, src, dest,
+                          policy=RetryPolicy(tries=3, base_s=0.01))
+    assert info["attempts"] == 2       # first torn, second clean
+    assert os.path.getsize(os.path.join(dest, "history.jsonl")) == \
+        os.path.getsize(os.path.join(src, "history.jsonl"))
+
+
+def test_pull_run_terminal_failure_leaves_no_partial(tmp_path):
+    src, _ = _seed_run_dir(tmp_path / "remote")
+    conn = remotes.FaultyRemote(
+        _local_conn(),
+        lambda kind: "partial" if kind == "download" else None)
+    dest = str(tmp_path / "store" / "demo-noop" / "t2")
+    with pytest.raises(fsync.SyncError, match="partial download"):
+        fsync.pull_run(conn, src, dest,
+                       policy=RetryPolicy(tries=2, base_s=0.01))
+    assert not os.path.exists(dest)
+    assert not os.path.isdir(store.sync_tmp_path()) \
+        or not os.listdir(store.sync_tmp_path())
+
+
+def test_fetch_on_demand_pulls_registered_runs(tmp_path):
+    src, _ = _seed_run_dir(tmp_path / "wstore")
+    rel = "demo-noop/t3"
+    fsync.register_pending(rel, kind="local",
+                           conn_spec={"host": "local"},
+                           remote_dir=src)
+    assert rel in fsync.pending()
+    # a path INSIDE the run dir matches its registration
+    assert fsync.fetch_on_demand(rel + "/results.json") is True
+    dest = os.path.join(os.path.abspath(store.base_dir), rel)
+    assert os.path.isdir(dest)
+    assert rel not in fsync.pending()
+    # unknown paths are a cheap no
+    assert fsync.fetch_on_demand("demo-noop/unknown") is False
+
+
+def test_web_files_fetch_on_demand(tmp_path):
+    """A browsed run link resolves even when the artifacts still live
+    on the worker: web's 404 path consults fleet.sync first."""
+    src, _ = _seed_run_dir(tmp_path / "wstore")
+    rel = "demo-noop/t4"
+    fsync.register_pending(rel, kind="local",
+                           conn_spec={"host": "local"},
+                           remote_dir=src)
+    server = web.serve({"ip": "127.0.0.1", "port": 0})
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        with urllib.request.urlopen(
+                f"{base}/files/{rel}/results.json", timeout=60) as r:
+            assert json.loads(r.read())["valid"] is True
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# dispatch + sync end to end (real loopback worker subprocesses)
+
+NOOP_OPTS = {"nodes": ["n1"], "concurrency": 1, "ssh": {"dummy?": True},
+             "time-limit": 1, "workload": "noop"}
+
+
+def _noop_cells(n=2):
+    return plan.expand({"axes": {"seed": list(range(n)),
+                                 "workload": ["noop"]}})
+
+
+def test_fleet_sync_with_isolated_worker_store(tmp_path):
+    """Workers write into their OWN store; every run directory must be
+    mirrored into the coordinator store and journaled."""
+    wstore = str(tmp_path / "wstore")
+    rep = dispatch.run_fleet(
+        _noop_cells(2), dispatch.parse_workers("local,local"),
+        campaign_id="sync", base_options=NOOP_OPTS, lease_s=120,
+        sync_timeout_s=60, worker_store_dir=wstore,
+        builder="jepsen_tpu.demo:demo_test")
+    assert rep["summary"]["outcomes"] == {"True": 2}
+    recs = store.latest_campaign_records("sync")
+    for r in recs:
+        assert r["synced"] is True
+        assert r["path"].startswith(os.path.abspath(store.base_dir))
+        assert os.path.isdir(r["path"])
+        assert os.path.exists(os.path.join(r["path"], "results.json"))
+        assert r["worker-path"].startswith(os.path.abspath(wstore))
+    evs = [e for e in store.campaign_events("sync")
+           if e["event"] == "artifact-sync"]
+    assert len(evs) == 2
+    assert all(e["status"] == "ok" and e["files"] > 0 for e in evs)
+    # web run links resolve for the mirrored runs
+    assert all(web._run_link(r["path"]) for r in recs)
+    assert not os.path.isdir(store.sync_tmp_path()) \
+        or not os.listdir(store.sync_tmp_path())
+
+
+def test_fleet_sync_failure_keeps_verdict_then_resume_resyncs(
+        tmp_path):
+    """Terminal sync failure: the verdict is kept (synced: false),
+    the run is registered for on-demand fetch, and --resume re-SYNCS
+    without re-running the cell."""
+    wstore = str(tmp_path / "wstore")
+    # every download torn, every attempt: sync can never succeed
+    broken = fchaos.ChaosProfile(
+        name="torn", seed=1,
+        download_partial_p=1.0, download_partial_max=10 ** 6)
+    cells = _noop_cells(1)
+    rep = dispatch.run_fleet(
+        cells, dispatch.parse_workers("local"),
+        campaign_id="resync", base_options=NOOP_OPTS, lease_s=120,
+        max_leases=1, sync_timeout_s=5, worker_store_dir=wstore,
+        chaos=broken, builder="jepsen_tpu.demo:demo_test")
+    assert rep["summary"]["outcomes"] == {"True": 1}
+    rec = store.latest_campaign_records("resync")[0]
+    assert rec["synced"] is False
+    assert rec["outcome"] is True          # the verdict survived
+    assert not os.path.exists(rec["path"])
+    assert fsync.pending()                 # web could pull it now
+    failed = [e for e in store.campaign_events("resync")
+              if e["event"] == "artifact-sync"
+              and e["status"] == "failed"]
+    assert failed
+    # no partial copy anywhere in the coordinator store
+    assert not os.path.isdir(store.sync_tmp_path()) \
+        or not os.listdir(store.sync_tmp_path())
+    # the terminal record journaled how to reach the worker's store
+    assert rec["worker-kind"] == "local"
+    assert rec["worker-conn"]["host"] == "local"
+    # --resume with a healthy transport AND a different worker list
+    # (the original worker id isn't in it): re-sync, not re-run,
+    # reaching the store via the journaled conn spec
+    rep2 = dispatch.run_fleet(
+        cells, dispatch.parse_workers("w2=localhost"),
+        campaign_id="resync", resume=True, base_options=NOOP_OPTS,
+        lease_s=120, sync_timeout_s=60, worker_store_dir=wstore,
+        builder="jepsen_tpu.demo:demo_test")
+    assert rep2["summary"]["skipped-resumed"] == 1
+    assert os.path.isdir(rec["path"])
+    assert os.path.exists(os.path.join(rec["path"], "results.json"))
+    ok = [e for e in store.campaign_events("resync")
+          if e["event"] == "artifact-sync" and e["status"] == "ok"]
+    assert len(ok) == 1
+    # the cell itself ran exactly once across both invocations
+    terminal = [r for r in store.load_campaign_records("resync")
+                if not r.get("event")]
+    assert len(terminal) == 1
+
+
+def test_fleet_sync_failure_requeues_within_lease_budget(tmp_path):
+    """With lease budget left, a failed sync forfeits the lease: the
+    cell re-RUNS (fresh artifacts) instead of landing unsynced."""
+    wstore = str(tmp_path / "wstore")
+    # the first FOUR downloads fail -- the whole internal retry
+    # budget of one pull (RetryPolicy.bounded tries=4), so lease 1's
+    # sync fails terminally; lease 2's pull finds a clean transport
+    # (or at worst one more absorbed failure) and succeeds
+    state = {"left": 4}
+
+    def faults(kind):
+        if kind == "download" and state["left"] > 0:
+            state["left"] -= 1
+            return "exit-255"
+        return None
+
+    workers = dispatch.parse_workers("local")
+    real_connect = workers[0].connect
+    workers[0].connect = \
+        lambda: remotes.FaultyRemote(real_connect(), faults)
+    rep = dispatch.run_fleet(
+        _noop_cells(1), workers,
+        campaign_id="requeue", base_options=NOOP_OPTS, lease_s=120,
+        max_leases=3, sync_timeout_s=3, worker_store_dir=wstore,
+        builder="jepsen_tpu.demo:demo_test")
+    assert rep["summary"]["outcomes"] == {"True": 1}
+    rec = store.latest_campaign_records("requeue")[0]
+    assert rec["synced"] is True and os.path.isdir(rec["path"])
+    assert rec["attempt"] == 2
+    evs = store.campaign_events("requeue")
+    assert any(e["event"] == "lease-failed"
+               and "artifact sync failed" in e["error"] for e in evs)
+    terminal = [r for r in store.load_campaign_records("requeue")
+                if not r.get("event")]
+    assert len(terminal) == 1
+
+
+class _KilledMidDownload:
+    """A transport whose download REALLY dies by SIGKILL partway
+    through copying the run directory -- a killed scp: some artifact
+    files land in the staging dir, one doesn't, and the copy process
+    exits -SIGKILL. The first ``times`` downloads die this way
+    (enough to exhaust one pull's whole retry budget); later ones
+    delegate to the clean inner transport."""
+
+    def __init__(self, inner, times):
+        self.inner = inner
+        self.left = times
+        self.exits = []
+
+    def execute(self, ctx, action):
+        return self.inner.execute(ctx, action)
+
+    def upload(self, ctx, local_paths, remote_path):
+        return self.inner.upload(ctx, local_paths, remote_path)
+
+    def download(self, ctx, remote_paths, local_path):
+        if self.left <= 0:
+            return self.inner.download(ctx, remote_paths, local_path)
+        self.left -= 1
+        # a real partial copy, then a real kill -9 of the copier:
+        # results.json never arrives, and $? is -SIGKILL like a
+        # snuffed scp's
+        p = subprocess.run(
+            ["sh", "-c",
+             f"cp -rp {shlex.quote(str(remote_paths))} "
+             f"{shlex.quote(str(local_path))} && "
+             f"rm -f {shlex.quote(str(local_path))}/results.json && "
+             "kill -9 $$"])
+        self.exits.append(p.returncode)
+        return {"cmd": "download", "out": "", "err": "Killed",
+                "exit": p.returncode}
+
+
+def test_worker_killed_mid_download_no_partials_requeued(tmp_path):
+    """THE crash-consistent-sync case: the worker side dies (kill -9)
+    mid-artifact-download, repeatedly enough that lease 1's sync
+    fails terminally. The coordinator store must never show a partial
+    run directory, the cell must be re-queued, and exactly one
+    terminal record must land with its artifacts mirrored."""
+    wstore = str(tmp_path / "wstore")
+    workers = dispatch.parse_workers("local")
+    real_connect = workers[0].connect
+    conns = []
+
+    def connect():
+        conns.append(_KilledMidDownload(real_connect(), times=4))
+        return conns[-1]
+
+    workers[0].connect = connect
+    rep = dispatch.run_fleet(
+        _noop_cells(1), workers,
+        campaign_id="midkill", base_options=NOOP_OPTS, lease_s=120,
+        max_leases=3, sync_timeout_s=3, worker_store_dir=wstore,
+        builder="jepsen_tpu.demo:demo_test")
+    assert rep["summary"]["outcomes"] == {"True": 1}
+    # the kills were real: SIGKILL exits, partial copies made
+    assert any(e == -signal.SIGKILL
+               for c in conns for e in c.exits)
+    # exactly one terminal record, artifacts mirrored
+    terminal = [r for r in store.load_campaign_records("midkill")
+                if not r.get("event")]
+    assert len(terminal) == 1
+    rec = terminal[0]
+    assert rec["synced"] is True
+    assert os.path.isdir(rec["path"])
+    assert os.path.exists(os.path.join(rec["path"], "results.json"))
+    # the cell was re-queued (lease forfeited, re-granted)
+    assert rec["attempt"] >= 2
+    evs = store.campaign_events("midkill")
+    assert any(e["event"] == "lease-failed"
+               and "artifact sync failed" in e["error"] for e in evs)
+    # NO partial run directory anywhere in the coordinator store:
+    # every run dir the browser can see has its results.json, and
+    # the staging area is empty
+    for name in store.test_names():
+        for t in store.tests(name):
+            assert os.path.exists(
+                os.path.join(store.base_dir, name, t,
+                             "results.json")), (name, t)
+    assert not os.path.isdir(store.sync_tmp_path()) \
+        or not os.listdir(store.sync_tmp_path())
+
+
+def test_chaos_soak_acceptance(tmp_path):
+    """THE acceptance run: 2 loopback workers under the seeded soak
+    profile (exec exit-255, transport hang, partial download, one
+    worker kill -9, torn ledger tail) with isolated worker stores.
+    Every cell must land terminal exactly once with its artifacts
+    mirrored, and the journal/ledger must stay well-formed."""
+    wstore = str(tmp_path / "wstore")
+    prof = fchaos.PROFILES["soak"].with_seed(42)
+    cells = _noop_cells(2)
+    # max_leases=5: the soak can stack kill -9 + hang-timeout +
+    # exit-255 (3 strikes) onto ONE cell depending on which worker
+    # grabs it, and the default budget of 3 would crash it -- chaos
+    # soaks raise the budget (the --max-leases help says exactly this)
+    rep = dispatch.run_fleet(
+        cells, dispatch.parse_workers("local,local"),
+        campaign_id="soak", base_options=NOOP_OPTS, lease_s=60,
+        max_leases=5, sync_timeout_s=30, worker_store_dir=wstore,
+        chaos=prof, builder="jepsen_tpu.demo:demo_test")
+    assert rep["status"] == "complete"
+    assert rep["summary"]["outcomes"] == {"True": 2}
+    meta = CampaignJournal("soak").load_meta()
+    assert meta["chaos"]["name"] == "soak"
+    assert meta["chaos"]["seed"] == 42
+    terminal = [r for r in store.load_campaign_records("soak")
+                if not r.get("event")]
+    per_cell = {}
+    for r in terminal:
+        per_cell[r["cell"]] = per_cell.get(r["cell"], 0) + 1
+    assert per_cell == {c["id"]: 1 for c in cells}
+    for r in terminal:
+        assert r["synced"] is True and os.path.isdir(r["path"])
+    # the kill -9 really fired: its die-once marker exists and at
+    # least one lease was forfeited and re-granted
+    kills = prof.plan_kills([c["id"] for c in cells])
+    assert len(kills) == 1
+    evs = store.campaign_events("soak")
+    assert sum(1 for e in evs if e["event"] == "lease") > 2
+    assert any(e["event"] == "lease-failed" for e in evs)
+    # the chaos-torn ledger tail was tolerated
+    st = fledger.Ledger(store.compile_ledger_path()).stats()
+    assert st["processes"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# admission control: authn, budgets, shed, drain
+
+
+def test_authorize_token_forms_and_401():
+    a = service.Admission(token="sekrit")
+    assert a.authorize("Bearer sekrit") == "token"
+    assert a.authorize("bearer sekrit") == "token"
+    assert a.authorize("sekrit") == "token"
+    for bad in (None, "", "Bearer nope", "Bearer sekri"):
+        with pytest.raises(service.ApiError) as ei:
+            a.authorize(bad)
+        assert ei.value.status == 401
+        assert ei.value.headers.get("WWW-Authenticate") == "Bearer"
+    # named tokens map to caller identities
+    a = service.Admission(tokens={"t1": "alice", "t2": "bob"})
+    assert a.authorize("Bearer t2") == "bob"
+    # no tokens configured: the client address is the identity
+    a = service.Admission()
+    assert a.authorize(None, client="10.0.0.9") == "10.0.0.9"
+
+
+def test_check_slot_budget_queue_and_shed():
+    a = service.Admission(budgets={"concurrent-checks": 1,
+                                   "queue-depth": 1},
+                          queue_wait_s=10.0)
+    entered = threading.Event()
+    release = threading.Event()
+    got = {}
+
+    def holder():
+        with a.check_slot("c"):
+            entered.set()
+            release.wait(30)
+
+    def waiter():
+        try:
+            with a.check_slot("c"):
+                got["waiter"] = "ran"
+        except service.ApiError as e:
+            got["waiter"] = e.status
+
+    t1 = threading.Thread(target=holder)
+    t1.start()
+    assert entered.wait(10)
+    t2 = threading.Thread(target=waiter)
+    t2.start()
+    # t2 occupies the whole queue (depth 1): the next caller sheds
+    # IMMEDIATELY as 429 + Retry-After instead of waiting
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if a.snapshot().get("c", {}).get("waiting"):
+            break
+        time.sleep(0.02)
+    with pytest.raises(service.ApiError) as ei:
+        a._admit("c", 0)
+    assert ei.value.status == 429
+    assert "Retry-After" in ei.value.headers
+    # freeing the slot admits the queued waiter
+    release.set()
+    t1.join(30)
+    t2.join(30)
+    assert got["waiter"] == "ran"
+
+
+def test_check_slot_ops_per_day_quota():
+    a = service.Admission(budgets={"ops-per-day": 10})
+    with a.check_slot("c", ops=8):
+        pass
+    with pytest.raises(service.ApiError) as ei:
+        a._admit("c", 5)
+    assert ei.value.status == 429
+    assert "quota" in ei.value.payload["error"]
+    assert int(ei.value.headers["Retry-After"]) >= 1
+    # a different caller has its own quota
+    with a.check_slot("other", ops=9):
+        pass
+
+
+def test_campaign_budget_claim_and_release():
+    a = service.Admission(budgets={"campaigns": 1})
+    a.campaign_slot("c")
+    with pytest.raises(service.ApiError) as ei:
+        a.campaign_slot("c")
+    assert ei.value.status == 429
+    a.campaign_done("c")
+    a.campaign_slot("c")          # released slot is reusable
+
+
+def test_drain_sheds_new_and_wakes_waiters():
+    a = service.Admission(budgets={"concurrent-checks": 1,
+                                   "queue-depth": 4},
+                          queue_wait_s=30.0)
+    entered = threading.Event()
+    release = threading.Event()
+    got = {}
+
+    def holder():
+        with a.check_slot("c"):
+            entered.set()
+            release.wait(30)
+
+    def waiter():
+        try:
+            with a.check_slot("c"):
+                got["w"] = "ran"
+        except service.ApiError as e:
+            got["w"] = e.status
+
+    t1 = threading.Thread(target=holder)
+    t1.start()
+    assert entered.wait(10)
+    t2 = threading.Thread(target=waiter)
+    t2.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if a.snapshot().get("c", {}).get("waiting"):
+            break
+        time.sleep(0.02)
+    a.drain()
+    t2.join(10)
+    assert got["w"] == 503        # the QUEUED waiter was woken + shed
+    with pytest.raises(service.ApiError) as ei:
+        a._admit("c", 0)
+    assert ei.value.status == 503
+    release.set()
+    t1.join(30)                   # in-flight work finished untouched
+
+
+def test_check_history_over_budget_does_not_touch_inflight():
+    service.configure(budgets={"concurrent-checks": 1,
+                               "queue-depth": 0})
+    hist = [
+        {"type": "invoke", "process": 0, "f": "write", "value": 1},
+        {"type": "ok", "process": 0, "f": "write", "value": 1},
+    ]
+    gate = service.admission()
+    with gate.check_slot("10.0.0.1"):
+        # the same caller is over budget: clean 429
+        with pytest.raises(service.ApiError) as ei:
+            service.check_history({"history": hist,
+                                   "model": "register",
+                                   "engine": "wgl"},
+                                  caller="10.0.0.1")
+        assert ei.value.status == 429
+        # ANOTHER caller's in-flight work is unaffected
+        out = service.check_history({"history": hist,
+                                     "model": "register",
+                                     "engine": "wgl"},
+                                    caller="10.0.0.2")
+        assert out["valid"] is True
+    # and after release the original caller is served again
+    out = service.check_history({"history": hist, "model": "register",
+                                 "engine": "wgl"}, caller="10.0.0.1")
+    assert out["valid"] is True
+
+
+def test_submit_campaign_releases_budget_when_done():
+    service.configure(budgets={"campaigns": 1})
+    cid, _meta = service.submit_campaign(
+        {"axes": {"seed": [0]},
+         "options": {"workload": "noop", "time-limit": 1}},
+        caller="alice")
+    with pytest.raises(service.ApiError) as ei:
+        service.submit_campaign({"axes": {"seed": [1]}},
+                                caller="alice")
+    assert ei.value.status == 429
+    service._campaigns[cid]["thread"].join(120)
+    # the finished campaign's slot is back; the run itself completed
+    cid2, _ = service.submit_campaign(
+        {"axes": {"seed": [2]},
+         "options": {"workload": "noop", "time-limit": 1}},
+        caller="alice")
+    service._campaigns[cid2]["thread"].join(120)
+    assert service.campaign_status(cid)["status"] == "complete"
+
+
+def test_web_serve_token_401_and_429_over_socket():
+    """The wire-level acceptance: no token = 401 (WWW-Authenticate),
+    over-budget = 429 + Retry-After, both as JSON."""
+    server = web.serve({"ip": "127.0.0.1", "port": 0,
+                        "token": "sekrit",
+                        "budgets": {"campaigns": 0}})
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    hist = [
+        {"type": "invoke", "process": 0, "f": "write", "value": 1},
+        {"type": "ok", "process": 0, "f": "write", "value": 1},
+    ]
+
+    def post(path, body, token=None):
+        h = {"Content-Type": "application/json"}
+        if token:
+            h["Authorization"] = f"Bearer {token}"
+        req = urllib.request.Request(base + path,
+                                     data=json.dumps(body).encode(),
+                                     headers=h)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return r.status, json.loads(r.read()), {}
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read()), dict(e.headers)
+
+    try:
+        code, body, hdrs = post(
+            "/api/check", {"history": hist, "model": "register",
+                           "engine": "wgl"})
+        assert code == 401 and "token" in body["error"]
+        assert hdrs.get("WWW-Authenticate") == "Bearer"
+        code, body, _ = post(
+            "/api/check", {"history": hist, "model": "register",
+                           "engine": "wgl"}, token="sekrit")
+        assert code == 200 and body["valid"] is True
+        code, body, hdrs = post("/api/campaigns",
+                                {"axes": {"seed": [0]}},
+                                token="sekrit")
+        assert code == 429
+        assert "Retry-After" in hdrs
+    finally:
+        server.shutdown()
+
+
+def test_web_token_gates_files_and_pages_too(tmp_path):
+    """With a token configured, the HTML/file routes are protected
+    like /api: the store's histories (and the on-demand scp pull a
+    /files miss can trigger) are what the token guards. Browsers
+    can't set headers, so ?token= works as well."""
+    run, rel = _seed_run_dir(store.base_dir)
+    server = web.serve({"ip": "127.0.0.1", "port": 0,
+                        "token": "sekrit"})
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(base + path, timeout=60) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    try:
+        for path in ("/", "/campaigns", f"/files/{rel}/results.json"):
+            code, _body = get(path)
+            assert code == 401, path
+        code, body = get(f"/files/{rel}/results.json?token=sekrit")
+        assert code == 200 and json.loads(body)["valid"] is True
+        code, _body = get("/?token=sekrit")
+        assert code == 200
+        code, _body = get("/?token=wrong")
+        assert code == 401
+        # raw-socket read: EXACTLY one response, and none of the
+        # protected content after the 401 (a gate that writes the
+        # error but doesn't STOP leaks the page on the same socket)
+        import socket as socketlib
+        s = socketlib.create_connection(
+            ("127.0.0.1", server.server_address[1]), timeout=30)
+        try:
+            s.sendall(b"GET /files/" + rel.encode()
+                      + b"/results.json HTTP/1.0\r\n\r\n")
+            raw = b""
+            while chunk := s.recv(65536):
+                raw += chunk
+        finally:
+            s.close()
+        assert raw.count(b"HTTP/1.") == 1, raw[:400]
+        assert b"401" in raw.split(b"\r\n", 1)[0]
+        assert b'"valid"' not in raw
+    finally:
+        server.shutdown()
+
+
+def test_shutdown_drains_before_aborting():
+    service.configure()
+    service.shutdown(join_s=0.1)
+    assert service.admission().draining
+    with pytest.raises(service.ApiError) as ei:
+        service.admission()._admit("c", 0)
+    assert ei.value.status == 503
+
+
+def test_admission_rejects_bad_budget_values():
+    with pytest.raises(ValueError):
+        service.Admission(budgets={"concurrent-checks": -1})
+    with pytest.raises(ValueError):
+        service.Admission(budgets={"queue-depth": 1.5})
+
+
+def test_admission_none_budget_means_unlimited():
+    """None is documented as 'off' for ops-per-day; every budget key
+    must honor it instead of TypeError-ing the request path."""
+    adm = service.Admission(budgets={
+        "concurrent-checks": None, "queue-depth": None,
+        "campaigns": None, "ops-per-day": None})
+    with contextlib.ExitStack() as stack:
+        for _ in range(50):
+            stack.enter_context(adm.check_slot("c", ops=10 ** 9))
+    for _ in range(50):
+        adm.campaign_slot("c")
+    for _ in range(50):
+        adm.campaign_done("c")
+
+
+def test_admission_prunes_idle_callers():
+    """Unauthenticated callers are keyed by client address: idle
+    state must be dropped, or the table grows per source IP forever."""
+    adm = service.Admission()
+    for i in range(100):
+        with adm.check_slot(f"10.0.0.{i}"):
+            pass
+    adm.campaign_slot("c")
+    adm.campaign_done("c")
+    assert adm.snapshot() == {}
+    # held state survives until released
+    with adm.check_slot("held"):
+        assert "held" in adm.snapshot()
+    assert adm.snapshot() == {}
+    # today's op spend is NOT pruned while a daily quota is on
+    quota = service.Admission(budgets={"ops-per-day": 100})
+    with quota.check_slot("spender", ops=60):
+        pass
+    assert quota.snapshot()["spender"]["ops"] == 60
+    with pytest.raises(service.ApiError) as ei:
+        with quota.check_slot("spender", ops=60):
+            pass
+    assert ei.value.status == 429
+
+
+# ---------------------------------------------------------------------------
+# planlint PL016
+
+
+def _codes(diags, severity=None):
+    return [d.code for d in diags
+            if severity is None or d.severity == severity]
+
+
+def test_pl016_nonloopback_serve_without_token():
+    d = planlint.lint_service({"serve?": True, "serve-ip": "0.0.0.0",
+                               "auth-token?": False})
+    assert _codes(d, "error") == ["PL016"]
+    # an UNSET bind means the 0.0.0.0 default: still an error
+    d = planlint.lint_service({"serve?": True, "auth-token?": False})
+    assert _codes(d, "error") == ["PL016"]
+    for ok in ({"serve?": True, "serve-ip": "127.0.0.1"},
+               {"serve?": True, "serve-ip": "localhost"},
+               {"serve?": True, "serve-ip": "0.0.0.0",
+                "auth-token?": True},
+               {"serve?": False}):
+        assert not planlint.lint_service(ok), ok
+
+
+def test_pl016_knob_values():
+    for bad in ({"budgets": {"concurrent-checks": 0}},
+                {"budgets": {"queue-depth": -2}},
+                {"budgets": {"ops-per-day": True}},
+                {"queue-wait-s": 0},
+                {"sync-timeout-s": -1},
+                {"sync-timeout-s": "fast"}):
+        d = planlint.lint_service(bad)
+        assert _codes(d, "error") == ["PL016"], bad
+    d = planlint.lint_service({"sync-timeout-s": 120, "lease-s": 60})
+    assert _codes(d, "warning") == ["PL016"]
+    assert not planlint.lint_service({"sync-timeout-s": 30,
+                                      "lease-s": 300})
+    assert not planlint.lint_service({"budgets": {
+        "concurrent-checks": 4, "ops-per-day": None}})
+
+
+def test_run_fleet_refuses_exposed_serve_without_token():
+    with pytest.raises(dispatch.FleetError, match="PL016"):
+        dispatch.run_fleet(_noop_cells(1),
+                           dispatch.parse_workers("local"),
+                           campaign_id="exposed",
+                           base_options=NOOP_OPTS, lease_s=120,
+                           serve=True, serve_ip="0.0.0.0")
+
+
+# ---------------------------------------------------------------------------
+# persistent jax compilation cache + cold/warm ledger stats
+
+
+def test_enable_jax_cache_points_jax_at_the_store():
+    import jax
+    prior = jax.config.jax_compilation_cache_dir
+    try:
+        path = fledger.enable_jax_cache()
+        assert path == os.path.abspath(
+            store.compile_ledger_path(fledger.JAX_CACHE_DIR))
+        assert os.path.isdir(path)
+        assert jax.config.jax_compilation_cache_dir == path
+        # idempotent: a second call leaves the config alone
+        assert fledger.enable_jax_cache() == path
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prior)
+
+
+def test_ledger_attach_enables_jax_cache_by_default():
+    import jax
+    prior = jax.config.jax_compilation_cache_dir
+    try:
+        fledger.attach()
+        want = os.path.abspath(
+            store.compile_ledger_path(fledger.JAX_CACHE_DIR))
+        assert jax.config.jax_compilation_cache_dir == want
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prior)
+
+
+def test_note_stats_cold_warm_wall_aggregates():
+    led = fledger.attach(jax_cache=False)
+    led.note_stats(2, 1, cold_wall_s=10.5, warm_wall_s=3.25)
+    sibling = fledger.Ledger(led.dir)
+    sibling.note_stats(4, 0, cold_wall_s=0.0, warm_wall_s=7.75)
+    st = led.stats()
+    assert st["hits"] == 6 and st["misses"] == 1
+    assert st["cold_wall_s"] == 10.5
+    assert st["warm_wall_s"] == 11.0
+    # walls are optional: a bare stats event still parses
+    led.note_stats(1, 1)
+    assert fledger.Ledger(led.dir).stats()["hits"] == 7

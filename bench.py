@@ -25,6 +25,9 @@ Rungs (BASELINE.md north-star table):
      checked with the searchplan analyzer on and off; the detail
      records segment count, config-count estimate vs actual, wall
      clock for both paths, and the planner's own cost fraction
+  11. obs overhead: the same fixed-op run with the tracer + crash-safe
+      telemetry journals ON vs obs OFF entirely; the fleet telemetry
+      plane must cost < 5% of clean-run wall clock
 
 The baseline is the sequential CPU WGL oracle (our knossos stand-in,
 checker/wgl.py) with a 60 s / config-capped budget per history.
@@ -458,6 +461,119 @@ def _searchplan_rung(keys=4, bursts=6):
         return out
     except Exception as exc:  # noqa: BLE001 - numbers, not crashes
         return {"error": repr(exc)[:300]}
+
+
+#: simulated per-op client latency for the obs-overhead rung, seconds.
+#: 0.5 ms is CONSERVATIVE: the reference framework's ops cross SSH to
+#: real database processes (network RTT alone is 0.1-1 ms; device-model
+#: ops are far slower), so a clean-run denominator built from 0.5 ms
+#: ops overstates the telemetry plane's relative cost, never hides it.
+OBS_RUNG_OP_S = 0.0005
+
+
+def _obs_overhead_rung(n_ops=4000, concurrency=8, pairs=6):
+    """Telemetry-plane overhead (jepsen_tpu.obs): the same fixed-op
+    run with obs OFF vs obs ON — where ON means the full fleet plane:
+    per-op trace spans, metrics, AND the incremental crash-safe
+    journals at the shipped default flush cadence. The client costs
+    OBS_RUNG_OP_S per op (see above: conservative vs any real op) at a
+    realistic concurrency (real campaigns run 5-64 workers; at
+    concurrency 2 the interpreter loop is artificially
+    dispatch-latency-bound and every microsecond of main-loop work
+    triples through a GIL convoy), which makes ``overhead_frac`` the
+    plane's share of a representative clean-run wall clock. One extra
+    OFF/ON pair runs with the noop client — ops that cost literally
+    nothing — and is reported as the ``stress_*`` detail: the
+    instrumentation's worst case against a degenerate denominator,
+    tracked but not the goal.
+
+    Methodology: OFF/ON runs strictly interleaved, overhead computed
+    from the per-variant MINIMUM. The shared CI/dev boxes this runs on
+    show hypervisor-steal noise far larger than the effect (identical
+    runs vary by 2-3x minutes apart); under additive load noise the
+    minimum is the standard quiet-floor estimator, and interleaving
+    keeps a slow stretch from landing entirely on one variant.
+    Goal: overhead < 5%."""
+    import tempfile
+
+    try:
+        from jepsen_tpu import checker as cc
+        from jepsen_tpu import client as jclient
+        from jepsen_tpu import core, store
+        from jepsen_tpu import generator as gen
+        from jepsen_tpu.os import noop as os_noop
+
+        class _DelayClient(jclient.Client):
+            def invoke(self, test, op):
+                time.sleep(OBS_RUNG_OP_S)
+                out = dict(op)
+                out["type"] = "ok"
+                return out
+
+            def reusable(self, test):
+                return True
+
+        def build(obs_on, delay):
+            return {
+                "name": "bench-obs-overhead",
+                "nodes": ["n1"], "concurrency": concurrency,
+                "ssh": {"dummy?": True}, "os": os_noop,
+                "client": _DelayClient() if delay else jclient.noop,
+                "checker": cc.unbridled_optimism(),
+                "generator": gen.clients(gen.limit(
+                    n_ops, gen.repeat({"f": "read"}))),
+                # default telemetry-flush-ms (500): the rung measures
+                # the plane as shipped, journals included
+                "obs?": obs_on,
+            }
+
+        def run_one(obs_on, delay=True):
+            t0 = time.perf_counter()
+            t = core.run(core.prepare_test(build(obs_on, delay)))
+            assert t["results"]["valid"] is True
+            return time.perf_counter() - t0, t
+
+        saved = store.base_dir
+        off_runs, on_runs = [], []
+        with tempfile.TemporaryDirectory() as tmp:
+            store.base_dir = tmp
+            try:
+                run_one(False)          # warm both code paths once
+                run_one(True)
+                for _ in range(pairs):
+                    off_runs.append(run_one(False)[0])
+                    s, t_on = run_one(True)
+                    on_runs.append(s)
+                stress_off = run_one(False, delay=False)[0]
+                stress_on = run_one(True, delay=False)[0]
+                trace_p = store.path(t_on, "trace.jsonl")
+                trace_events = sum(1 for _ in open(trace_p)) \
+                    if trace_p and __import__("os").path.exists(
+                        trace_p) else None
+            finally:
+                store.base_dir = saved
+        off_s, on_s = min(off_runs), min(on_runs)
+        overhead = (on_s - off_s) / off_s if off_s > 0 else None
+        return {
+            "n_ops": n_ops, "pairs": pairs,
+            "op_cost_s": OBS_RUNG_OP_S,
+            "off_s": round(off_s, 4),
+            "off_runs": [round(x, 3) for x in off_runs],
+            "on_s": round(on_s, 4),
+            "on_runs": [round(x, 3) for x in on_runs],
+            "trace_events": trace_events,
+            "overhead_frac": (round(overhead, 4)
+                              if overhead is not None else None),
+            "stress_off_s": round(stress_off, 4),
+            "stress_on_s": round(stress_on, 4),
+            "stress_overhead_frac": round(
+                (stress_on - stress_off) / stress_off, 4)
+            if stress_off > 0 else None,
+            "goal": "< 0.05",
+            "goal_met": (overhead is not None and overhead < 0.05),
+        }
+    except Exception as exc:  # noqa: BLE001 - numbers, not crashes
+        return {"error": repr(exc)}
 
 
 def _error_headline(msg):
@@ -945,6 +1061,11 @@ def _bench_body(_obs_reg):
     # clean fleet, plus the warm-restart win from the persistent jax
     # compilation cache (CPU subprocesses; see the rung's docstring)
     rungs["10-fleet-survival"] = _fleet_survival_rung()
+
+    # obs-overhead rung: the fleet telemetry plane (tracer + metrics +
+    # crash-safe journals) must stay under 5% of clean-run wall clock
+    # on the interpreter hot path (pure host work; chip not involved)
+    rungs["11-obs-overhead"] = _obs_overhead_rung()
 
     # CPU oracles race in parallel subprocesses AFTER all device
     # measurements (their CPU load would pollute the device numbers);

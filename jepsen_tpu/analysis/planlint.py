@@ -58,6 +58,14 @@ Codes:
                  worker lease, so syncing holds a finished cell's
                  lease open longer than the death-detection bound
                  (warning)
+  PL017 mixed    telemetry plane: a non-positive telemetry-flush-ms
+                 (the crash-safe journal would never flush), or
+                 GET /api/metrics exposed on a non-loopback bind
+                 with no auth token (the metrics body names worker
+                 hosts, campaign ids, and live queue depths) --
+                 errors; a campaign trace merge requested with
+                 artifact sync explicitly disabled, so the merge has
+                 no mirrored per-run traces to fold (warning)
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -76,8 +84,8 @@ from .histlint import model_op_set
 logger = logging.getLogger(__name__)
 
 __all__ = ["lint_plan", "lint_campaign", "lint_fleet", "lint_service",
-           "preflight", "PlanLintError", "FATAL_CODES",
-           "monitor_diags", "searchplan_diags"]
+           "lint_telemetry", "preflight", "PlanLintError",
+           "FATAL_CODES", "monitor_diags", "searchplan_diags"]
 
 #: error codes certain enough to abort the run before node contact
 FATAL_CODES = {"PL001", "PL003", "PL004", "PL005", "PL006"}
@@ -241,6 +249,10 @@ def lint_plan(test):
 
     # -- search-plan knobs (jepsen_tpu.analysis.searchplan) ------------
     diags += searchplan_diags(test)
+
+    # -- telemetry-plane knobs (jepsen_tpu.obs) ------------------------
+    diags += lint_telemetry(
+        {"telemetry-flush-ms": test.get("telemetry-flush-ms")})
     return diags
 
 
@@ -630,6 +642,52 @@ def lint_service(cfg):
             "worker-death detection bound itself",
             "fleet.sync-timeout-s",
             "keep the artifact-sync budget well under the lease TTL"))
+    return diags
+
+
+def lint_telemetry(cfg):
+    """PL017: telemetry-plane preflight, before any journal is opened
+    or metrics endpoint bound. Recognized keys: ``telemetry-flush-ms``
+    (the crash-safe journal flush interval), ``metrics?`` (whether
+    GET /api/metrics will be served), ``serve-ip`` / ``auth-token?``
+    (the bind it would be served on), ``trace-merge?`` (whether the
+    campaign trace merge is requested), and ``sync?`` (tri-state:
+    False = artifact sync explicitly off, None = auto/unknown)."""
+    diags = []
+    cfg = cfg or {}
+    fl = cfg.get("telemetry-flush-ms")
+    if fl is not None and (not isinstance(fl, (int, float))
+                           or isinstance(fl, bool) or fl <= 0):
+        diags.append(diag(
+            "PL017", ERROR,
+            f"telemetry-flush-ms must be a positive number, got "
+            f"{fl!r}",
+            "telemetry.flush-ms",
+            "the incremental trace/metrics journals flush on this "
+            "interval; a non-positive value means a kill -9 loses "
+            "everything since the last event — omit the key for the "
+            "500 ms default"))
+    if cfg.get("metrics?"):
+        ip = cfg.get("serve-ip")
+        if str(ip or "0.0.0.0") not in _LOOPBACK_BINDS \
+                and not cfg.get("auth-token?"):
+            diags.append(diag(
+                "PL017", ERROR,
+                f"GET /api/metrics would bind {ip or '0.0.0.0'!r} "
+                "(non-loopback) with no auth token: the exposition "
+                "body names worker hosts, campaign ids, and live "
+                "queue depths",
+                "telemetry.metrics",
+                "pass --auth-token (or bind 127.0.0.1)"))
+    if cfg.get("trace-merge?") and cfg.get("sync?") is False:
+        diags.append(diag(
+            "PL017", WARNING,
+            "campaign trace merge requested with artifact sync "
+            "disabled: remote cells' trace.jsonl files are never "
+            "mirrored home, so the merged timeline will hold only "
+            "the coordinator lane",
+            "telemetry.trace-merge",
+            "re-enable artifact sync, or pass --no-trace-merge"))
     return diags
 
 

@@ -191,7 +191,20 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
 
     latch = latch or robust.AbortLatch()
     sem = threading.BoundedSemaphore(max(1, int(device_slots)))
-    tr, reg = Tracer(), Registry()
+    tr = Tracer(context={"campaign": campaign_id,
+                         "role": "coordinator"})
+    reg = Registry()
+    # crash-safe campaign telemetry: a kill -9'd coordinator leaves
+    # its scheduling trace + counters readable next to cells.jsonl
+    try:
+        tr.attach_journal(
+            store.campaign_path(campaign_id, store.TRACE_JOURNAL_FILE))
+        reg.attach_journal(
+            store.campaign_path(campaign_id,
+                                store.METRICS_JOURNAL_FILE))
+    except Exception:  # noqa: BLE001 - journals are insurance
+        logger.warning("couldn't attach campaign telemetry journals",
+                       exc_info=True)
     led = None
     if ledger:
         try:
@@ -240,6 +253,12 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
                 test.setdefault("campaign", {}).update(
                     {"id": campaign_id, "cell": cid,
                      "params": cell.get("params") or {}})
+                # trace-context propagation: the cell's own run-scope
+                # tracer/registry stamp every span and metric with
+                # {campaign, cell}, so obs.merge can fold the run's
+                # trace into the campaign timeline
+                test.setdefault("obs-context",
+                                {"campaign": campaign_id, "cell": cid})
                 test["abort"] = latch
                 if backends is not None:
                     # failover tiering: a down accelerator degrades
@@ -320,48 +339,62 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
         logger.warning("campaign %s hard-aborted (%r); journal is "
                        "resumable with --resume", campaign_id, e)
 
-    cc = compile_cache.delta(cc_before)
-    reg.set_gauge("campaign.compile_cache.hits", cc["hits"])
-    reg.set_gauge("campaign.compile_cache.misses", cc["misses"])
-    if led is not None:
-        # persist this campaign's reuse delta, then surface the
-        # cross-process aggregate: hits observed across SEPARATE
-        # scheduler processes are the ledger's whole point. The
-        # cold/warm wall split is the persistent jax compile cache's
-        # before/after evidence (see fleet.ledger.enable_jax_cache)
-        from ..fleet.ledger import fold_walls
-        # THIS run's cells only: resumed cells' walls already landed
-        # in the prior process's stats event, and Ledger.stats sums
-        # events -- re-folding them would inflate cold/warm per resume
-        cold, warm = fold_walls([r for r in jr.latest()
-                                 if str(r.get("cell")) not in done])
-        led.note_stats(cc["hits"], cc["misses"], cold_wall_s=cold,
-                       warm_wall_s=warm)
-        try:
-            cc = dict(cc, ledger=led.stats())
-        except Exception:  # noqa: BLE001 - bookkeeping only
-            logger.warning("couldn't aggregate compile-ledger stats",
-                           exc_info=True)
-    aborted = latch.is_set()
-    # the journal is the source of truth, latest record per cell: on a
-    # hard abort, pool threads may have journaled cells whose futures
-    # were never drained
-    report = creport.summarize(
-        jr.latest(),
-        meta={"id": campaign_id}, compile_cache=cc, aborted=aborted,
-        abort_reason=latch.reason, skipped=len(done))
-    jr.write_report(report)
     try:
-        tr.dump(store.campaign_path(campaign_id, "trace.jsonl"))
-        store._dump_json(reg.snapshot(),
-                         store.campaign_path(campaign_id,
-                                             "metrics.json"))
-    except Exception:  # noqa: BLE001 - telemetry is a byproduct
-        logger.warning("couldn't write campaign obs artifacts",
-                       exc_info=True)
-    jr.write_meta({**(jr.load_meta() or {}),
-                   "status": "aborted" if aborted else "complete",
-                   "updated": store.local_time()})
-    if hard_abort is not None:
-        raise hard_abort
-    return report
+        cc = compile_cache.delta(cc_before)
+        reg.set_gauge("campaign.compile_cache.hits", cc["hits"])
+        reg.set_gauge("campaign.compile_cache.misses", cc["misses"])
+        if led is not None:
+            # persist this campaign's reuse delta, then surface the
+            # cross-process aggregate: hits observed across SEPARATE
+            # scheduler processes are the ledger's whole point. The
+            # cold/warm wall split is the persistent jax compile
+            # cache's before/after evidence (fleet.ledger's
+            # enable_jax_cache)
+            from ..fleet.ledger import fold_walls
+            # THIS run's cells only: resumed cells' walls already
+            # landed in the prior process's stats event, and
+            # Ledger.stats sums events -- re-folding them would
+            # inflate cold/warm per resume
+            cold, warm = fold_walls([r for r in jr.latest()
+                                     if str(r.get("cell"))
+                                     not in done])
+            led.note_stats(cc["hits"], cc["misses"], cold_wall_s=cold,
+                           warm_wall_s=warm)
+            try:
+                cc = dict(cc, ledger=led.stats())
+            except Exception:  # noqa: BLE001 - bookkeeping only
+                logger.warning("couldn't aggregate compile-ledger "
+                               "stats", exc_info=True)
+        aborted = latch.is_set()
+        # the journal is the source of truth, latest record per cell:
+        # on a hard abort, pool threads may have journaled cells whose
+        # futures were never drained
+        report = creport.summarize(
+            jr.latest(),
+            meta={"id": campaign_id}, compile_cache=cc,
+            aborted=aborted, abort_reason=latch.reason,
+            skipped=len(done))
+        jr.write_report(report)
+        try:
+            tr.dump(store.campaign_path(campaign_id, "trace.jsonl"))
+            tr.close_journal(remove=True)
+            store._dump_json(reg.snapshot(),
+                             store.campaign_path(campaign_id,
+                                                 "metrics.json"))
+            reg.close_journal(remove=True)
+        except Exception:  # noqa: BLE001 - telemetry is a byproduct
+            logger.warning("couldn't write campaign obs artifacts",
+                           exc_info=True)
+        jr.write_meta({**(jr.load_meta() or {}),
+                       "status": "aborted" if aborted else "complete",
+                       "updated": store.local_time()})
+        if hard_abort is not None:
+            raise hard_abort
+        return report
+    finally:
+        # stop the journal flusher threads on EVERY exit path: on the
+        # happy path the dumps above already closed them (remove=True)
+        # and these are no-ops; on an exceptional exit the journal
+        # files are kept -- they are the crash evidence
+        tr.close_journal()
+        reg.close_journal()

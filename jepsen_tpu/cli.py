@@ -435,6 +435,18 @@ def _add_campaign_opts(parser, axes=False):
                             help="Wall bound for mirroring one remote "
                                  "cell's run directory into the "
                                  "coordinator store (default 120).")
+        parser.add_argument("--telemetry-flush-ms", type=float,
+                            default=None, metavar="MS",
+                            help="Crash-safe telemetry journal flush "
+                                 "interval for every cell run "
+                                 "(default 500; PL017 rejects "
+                                 "non-positive values).")
+        parser.add_argument("--no-trace-merge", action="store_true",
+                            help="Skip folding the per-run traces "
+                                 "into campaign_trace.jsonl at fleet "
+                                 "finalize (the merged Perfetto "
+                                 "timeline with one lane per worker, "
+                                 "clocks skew-normalized).")
         parser.add_argument("--chaos-profile", default=None,
                             metavar="NAME[:SEED]",
                             help="Fleet chaos soak: inject a seeded, "
@@ -624,6 +636,17 @@ def campaign_cmd(opts):
                 "sync-timeout-s": options.get("sync-timeout"),
                 "lease-s": options.get("lease"),
             })
+        # telemetry-plane preflight (PL017) rides along the same way:
+        # flush-knob sanity always, the exposed-metrics and
+        # merge-without-sync rules whenever serving / fleet-dispatching
+        diags += analysis.planlint.lint_telemetry({
+            "telemetry-flush-ms": options.get("telemetry-flush-ms"),
+            "metrics?": bool(options.get("serve")),
+            "serve-ip": options.get("serve-ip"),
+            "auth-token?": bool(options.get("auth-token")),
+            "trace-merge?": workers is not None
+            and not options.get("no-trace-merge"),
+        })
         if options.get("chaos-profile"):
             from .fleet import chaos as fchaos
             try:
@@ -668,7 +691,8 @@ def campaign_cmd(opts):
                     sync_timeout_s=options.get("sync-timeout"),
                     chaos=options.get("chaos-profile"),
                     serve_ip=options.get("serve-ip"),
-                    auth_token=options.get("auth-token"))
+                    auth_token=options.get("auth-token"),
+                    trace_merge=not options.get("no-trace-merge"))
             except fleet.FleetError as e:
                 raise CliError(str(e)) from e
             print(campaign.report.render_text(report))

@@ -288,6 +288,16 @@ class FaultyRemote(Remote):
         if hasattr(self.inner, "disconnect"):
             self.inner.disconnect()
 
+    def _note(self, kind, fault):
+        """A chaos injection is a first-class trace instant: the soak's
+        fault schedule must be readable off the merged campaign trace,
+        not reverse-engineered from log lines. No-op while obs is
+        unbound (the dispatcher binds its pair around worker loops)."""
+        from .. import obs
+        obs.instant("chaos.fault", cat="chaos", kind=str(kind),
+                    fault=str(fault))
+        obs.inc("chaos.faults", kind=str(kind), fault=str(fault))
+
     def _fault_result(self, fault, ctx, action):
         import time as _t
         out = dict(action if isinstance(action, dict) else
@@ -338,22 +348,26 @@ class FaultyRemote(Remote):
     def execute(self, ctx, action):
         fault = self.faults("execute")
         if fault is not None:
+            self._note("execute", fault)
             return self._fault_result(fault, ctx, action)
         return self.inner.execute(ctx, action)
 
     def upload(self, ctx, local_paths, remote_path):
         fault = self.faults("upload")
         if fault is not None:
+            self._note("upload", fault)
             return self._fault_result(fault, ctx, {"cmd": "upload"})
         return self.inner.upload(ctx, local_paths, remote_path)
 
     def download(self, ctx, remote_paths, local_path):
         fault = self.faults("download")
         if fault is not None and fault != "partial":
+            self._note("download", fault)
             return self._fault_result(fault, ctx, {"cmd": "download"})
         res = self.inner.download(ctx, remote_paths, local_path)
         if fault == "partial" and isinstance(res, dict) \
                 and res.get("exit") == 0:
+            self._note("download", fault)
             try:
                 self._maim(local_path)
             except OSError:  # pragma: no cover - fs hiccup

@@ -455,6 +455,13 @@ def run(test):
             with with_logging(test):
                 with obs.span("jepsen.run",
                               test_name=str(test.get("name"))):
+                    # crash-safe telemetry: journal trace events +
+                    # metric snapshots incrementally from here on
+                    # (append+flush, HistoryJournal discipline), so
+                    # even a kill -9 leaves the run's telemetry
+                    # readable for the fleet's artifact sync
+                    if test.get("name") and test.get("obs"):
+                        store.open_obs_journals(test)
                     # plan preflight: fail fast on wiring defects,
                     # before sessions/OS/DB touch any node
                     preflight(test)
@@ -511,7 +518,7 @@ def run(test):
             # drop the handles — the tracer buffer can hold up to 1M
             # event dicts, which a retained test map must not pin.
             if test.get("name") and test.get("obs"):
-                store.write_obs(test)
+                store.write_obs(test, final=True)
             test.pop("obs", None)
             test.pop("abort", None)
     return test

@@ -38,9 +38,10 @@ import json
 import logging
 import os
 import threading
+import time
 import traceback
 
-from .. import robust, store
+from .. import obs, robust, store
 from ..control import remotes
 from ..obs import Registry, Tracer
 from ..campaign import compile_cache
@@ -149,7 +150,7 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
               backends=None, python=None, cwd=None, serve=False,
               device_slots=1, probe=True, env=None, sync="auto",
               worker_store_dir=None, sync_timeout_s=None, chaos=None,
-              serve_ip=None, auth_token=None):
+              serve_ip=None, auth_token=None, trace_merge=True):
     """Run a campaign across worker hosts; returns the report dict
     (persisted as report.json, same shape as scheduler.run_cells).
 
@@ -175,7 +176,19 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     **Chaos** (``chaos``): a ``fleet.chaos`` profile (or its
     ``"name:seed"`` spec) wraps every worker transport in
     `remotes.FaultyRemote` and schedules worker kill -9s, so the
-    lease/steal/sync machinery is exercised under seeded faults."""
+    lease/steal/sync machinery is exercised under seeded faults.
+
+    **Telemetry** (``trace_merge``): the coordinator mints the
+    campaign trace context, ships it to every worker in the cell spec
+    (workers stamp their spans/metrics with {campaign, cell, worker}
+    and journal them crash-safely), records the lease clock handshake
+    on both sides, and — when ``trace_merge`` is on — folds every
+    mirrored run trace into ``campaign_trace.jsonl`` at finalize with
+    worker clocks normalized onto its own (obs.merge). The dispatch
+    tracer/registry are also bound process-globally for the
+    campaign's duration, so chaos injections, sync pulls, and probes
+    emit first-class events, and registered /api/metrics sources
+    serve the live lease/queue gauges."""
     from ..analysis import planlint, render_text, errors as diag_errors
     from . import sync as fsync
 
@@ -202,6 +215,16 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         "auth-token?": bool(auth_token),
         "sync-timeout-s": sync_timeout_s,
         "lease-s": lease_s,
+    })
+    # PL017: telemetry-plane preflight — flush knob sanity, exposed
+    # /api/metrics, and a trace merge that artifact sync can't feed
+    diags += planlint.lint_telemetry({
+        "telemetry-flush-ms": base_options.get("telemetry-flush-ms"),
+        "metrics?": serve,
+        "serve-ip": serve_ip,
+        "auth-token?": bool(auth_token),
+        "trace-merge?": trace_merge,
+        "sync?": sync if isinstance(sync, bool) else None,
     })
     # PL015 rides along like PL013/PL014: the workers rebuild test
     # maps from these base options, so searchplan knob mistakes
@@ -250,7 +273,20 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     })
 
     latch = latch or robust.AbortLatch()
-    tr, reg = Tracer(), Registry()
+    tr = Tracer(context={"campaign": campaign_id,
+                         "role": "coordinator"})
+    reg = Registry()
+    # crash-safe coordinator telemetry: journal dispatch spans +
+    # fleet counters next to cells.jsonl (kill -9 leaves them)
+    try:
+        tr.attach_journal(
+            store.campaign_path(campaign_id, store.TRACE_JOURNAL_FILE))
+        reg.attach_journal(
+            store.campaign_path(campaign_id,
+                                store.METRICS_JOURNAL_FILE))
+    except Exception:  # noqa: BLE001 - journals are insurance
+        logger.warning("couldn't attach fleet telemetry journals",
+                       exc_info=True)
     led = None
     if ledger:
         try:
@@ -315,6 +351,39 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         cond.notify_all()
         return True
 
+    folded_cells = set()
+
+    def _fold_worker_metrics(rec):
+        """Fold the headline gauges out of a finished cell's own
+        metrics artifact (monitor detection latency + violations) into
+        the live fleet registry, so ``GET /api/metrics`` serves them
+        while the campaign is still running. Best effort: the file is
+        local only for shared-store/synced cells. Folded at most ONCE
+        per cell — a forfeited-sync re-run would otherwise re-inc the
+        violation counter per attempt (detection latency is safe via
+        max_gauge, the counter is not)."""
+        try:
+            if rec.get("cell") in folded_cells:
+                return
+            folded_cells.add(rec.get("cell"))
+            p = rec.get("path")
+            if not p or not os.path.isdir(str(p)):
+                return
+            m = store.load_run_metrics(str(p))
+            if not m:
+                return
+            cid = str(rec.get("cell"))
+            for k, v in (m.get("gauges") or {}).items():
+                if k.startswith("monitor.detection_latency_s"):
+                    reg.max_gauge("monitor.detection_latency_s",
+                                  float(v), cell=cid)
+            for k, v in (m.get("counters") or {}).items():
+                if k.startswith("monitor.violations"):
+                    reg.inc("monitor.violations", int(v), cell=cid)
+        except Exception:  # noqa: BLE001 - telemetry fold only
+            logger.warning("couldn't fold worker metrics",
+                           exc_info=True)
+
     def requeue_or_fail(cid, worker_id, error):
         """A lease was forfeited: steal (requeue) or, past the attempt
         budget, journal the cell crashed. Caller holds ``cond``."""
@@ -323,6 +392,9 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         jr.append_event({"event": "lease-failed", "cell": cid,
                          "worker": worker_id, "error": str(error)[:500],
                          "t": store.local_time()})
+        tr.instant("fleet.lease.steal", cat="fleet",
+                   args={"cell": cid, "worker": str(worker_id),
+                         "error": str(error)[:200]})
         if table.attempts(cid) >= max_leases:
             finish(cid, {"cell": cid,
                          "group": by_id[cid].get("group") or cid,
@@ -333,13 +405,17 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                                   f"{str(error)[:300]}"})
         elif cid not in [c["id"] for c in pending]:
             pending.append(by_id[cid])
-            reg.inc("fleet.cells_stolen")
+            reg.inc("fleet.cells_stolen", worker=str(worker_id))
             cond.notify_all()
 
     def on_lease_expired(lease):
         """LeaseWatchdog backstop: the transport wedged past its own
         timeout; put the cell back up for stealing."""
         reg.inc("fleet.lease_expired")
+        tr.instant("fleet.lease.expired", cat="fleet",
+                   args={"cell": lease.unit, "worker": lease.holder,
+                         "attempt": lease.attempt,
+                         "ttl_s": lease.ttl_s})
         with cond:
             jr.append_event({"event": "lease-expired",
                              "cell": lease.unit,
@@ -362,7 +438,7 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                     return None
                 cond.wait(timeout=0.5)
 
-    def cell_spec(cell, worker):
+    def cell_spec(cell, worker, attempt=1):
         spec = {"campaign": campaign_id, "cell": cell["id"],
                 "group": cell.get("group") or cell["id"],
                 "params": cell.get("params") or {},
@@ -370,7 +446,15 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                 "builder": builder or "jepsen_tpu.demo:demo_test",
                 "store-dir": worker_store,
                 "worker": worker.id,
-                "ledger": bool(ledger)}
+                "ledger": bool(ledger),
+                # trace-context propagation: the worker binds these
+                # into obs so every span/metric it emits carries
+                # {campaign, cell, worker}; the coord-sent stamp is
+                # the first leg of the clock handshake obs.merge
+                # normalizes worker clocks with
+                "trace": {"campaign": campaign_id, "cell": cell["id"],
+                          "worker": worker.id, "attempt": attempt,
+                          "coord-sent-epoch": time.time()}}
         if cell["id"] in kill_cells:
             # chaos-scheduled kill -9: the die-once marker makes the
             # FIRST lease die mid-run and every later lease run clean
@@ -384,7 +468,8 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     def journal_sync(cell, wid, status, info=None, **extra):
         """One ``artifact-sync`` event record + metric (the sync_rec
         and resume-resync paths must journal identically)."""
-        reg.inc("fleet.artifact_syncs", status=status)
+        reg.inc("fleet.artifact_syncs", status=status,
+                worker=str(wid))
         jr.append_event({"event": "artifact-sync", "cell": cell,
                          "worker": wid, "status": status,
                          **{k: info[k] for k in
@@ -441,7 +526,11 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                          "worker": worker.id, "lease-s": lease_s,
                          "attempt": lease.attempt,
                          "t": store.local_time()})
-        spec = cell_spec(cell, worker)
+        tr.instant("fleet.lease.grant", cat="fleet",
+                   args={"cell": cid, "worker": worker.id,
+                         "attempt": lease.attempt})
+        reg.set_gauge("fleet.lease_active", len(table.active()))
+        spec = cell_spec(cell, worker, attempt=lease.attempt)
         ctx = {"dir": cwd, "timeout": lease_s}
         if env or spec.get("backend"):
             ctx["env"] = dict(env or {})
@@ -459,9 +548,21 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
             except Exception:  # noqa: BLE001 - transport crash
                 res = {"exit": -1, "err": traceback.format_exc(limit=4),
                        "out": ""}
+        coord_received = time.time()
         from .worker import parse_result
         rec = parse_result(res.get("out")) if res.get("exit") == 0 \
             else None
+        if rec is not None:
+            # close the clock handshake: the worker stamped its
+            # receive/result wall times into rec["clock"]; the
+            # coordinator's send/receive stamps complete the four
+            # obs.merge's skew estimate needs
+            clock = rec.setdefault("clock", {})
+            if isinstance(clock, dict):
+                clock.setdefault("coord-sent-epoch",
+                                 spec["trace"]["coord-sent-epoch"])
+                clock["coord-received-epoch"] = coord_received
+            _fold_worker_metrics(rec)
         sync_err = None
         if rec is not None and needs_sync(worker):
             # hold the watchdog off during the download (best effort:
@@ -474,6 +575,7 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                          args={"cell": cid, "worker": worker.id}):
                 sync_err = sync_rec(worker, conn, lease, rec)
         current = table.release(lease)
+        reg.set_gauge("fleet.lease_active", len(table.active()))
         with cond:
             if rec is not None:
                 if sync_err is not None and current \
@@ -512,6 +614,16 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         return ok, res
 
     def worker_loop(worker):
+        # bind the fleet pair for THIS thread's whole tenure: chaos
+        # fault injections (remotes.FaultyRemote) and artifact-sync
+        # pulls deep in the transport stack emit through the obs
+        # facade, and without a binding they would be invisible —
+        # the exact gap this plane closes. The bind stack makes N
+        # worker threads pushing the same pair safe.
+        with obs.bind(tr, reg):
+            _worker_loop(worker)
+
+    def _worker_loop(worker):
         try:
             conn = worker.connect()
             if chaos is not None:
@@ -524,7 +636,10 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         except Exception as exc:  # noqa: BLE001
             conn, exc_ = None, exc
         if probe and conn is not None:
-            perr = worker.probe()
+            with tr.span("fleet.probe", cat="fleet",
+                         args={"worker": worker.id,
+                               "kind": worker.kind}):
+                perr = worker.probe()
         else:
             perr = None if conn is not None else repr(exc_)
         if perr is not None:
@@ -639,93 +754,146 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
 
     if not workers:
         raise FleetError("fleet dispatch needs at least one worker")
-    if resume and done:
-        resync_done_cells()
-    watchdog = robust.LeaseWatchdog(table, on_lease_expired,
-                                    poll_s=min(1.0, lease_s / 4))
-    hard_abort = None
-    cc_before = compile_cache.stats()
+
+    def _live_gauges():
+        """The dispatcher's live state for GET /api/metrics: lease
+        occupancy, queue depth, worker liveness — plus everything the
+        fleet registry already counts."""
+        with cond:
+            extra = {"fleet.lease_active": len(table.active()),
+                     "fleet.pending_cells": len(pending),
+                     "fleet.terminal_cells": len(terminal),
+                     "fleet.workers_alive": len(alive)}
+        return [reg, {"gauges": extra}]
+
+    from . import service as fservice
+    metrics_source = fservice.register_metrics_source(
+        f"fleet:{campaign_id}", _live_gauges)
     try:
-        with robust.signal_scope(latch):
-            with tr.span("fleet.dispatch", cat="fleet",
-                         args={"id": campaign_id, "cells": len(pending),
-                               "workers": len(workers)}):
-                watchdog.start()
-                threads = [threading.Thread(
-                    target=worker_loop, args=(w,),
-                    name=f"jepsen fleet {w.id}") for w in workers]
-                for t in threads:
-                    t.start()
-                for t in threads:
-                    while t.is_alive():
-                        t.join(timeout=0.5)
-    except BaseException as e:  # noqa: BLE001 - finalize, then rethrow
-        hard_abort = e
-        if not latch.is_set():
-            latch.set(repr(e))
-        logger.warning("fleet campaign %s hard-aborted (%r); journal "
-                       "is resumable with --resume", campaign_id, e)
-    finally:
-        watchdog.stop()
-
-    unfinished = set(ids) - terminal
-    if unfinished and not latch.is_set():
-        # every worker died with cells left: surface it as an abort so
-        # the exit code and status say "incomplete", not "passed"
-        latch.set("workers-exhausted")
-        logger.warning("fleet campaign %s: workers exhausted with %d "
-                       "cell(s) unfinished", campaign_id,
-                       len(unfinished))
-
-    # compile reuse: the coordinator itself compiles nothing -- sum
-    # THIS run's workers' deltas from their records (cells resumed
-    # from a prior process already reported theirs in that process's
-    # stats event; re-folding them would double-count on every
-    # --resume), then fold in the persisted ledger aggregate
-    recs = jr.latest()
-    fresh = [r for r in recs if str(r.get("cell")) not in done]
-    cc = {"hits": 0, "misses": 0}
-    for r in fresh:
-        w = r.get("compile-cache") or {}
-        cc["hits"] += int(w.get("hits") or 0)
-        cc["misses"] += int(w.get("misses") or 0)
-    local = compile_cache.delta(cc_before)
-    cc["hits"] += local["hits"]
-    cc["misses"] += local["misses"]
-    reg.set_gauge("campaign.compile_cache.hits", cc["hits"])
-    reg.set_gauge("campaign.compile_cache.misses", cc["misses"])
-    if led is not None:
-        # cold/warm compile wall: cells whose own delta had misses
-        # paid a compile (cold); all-hit cells rode the caches (warm).
-        # With the persistent jax compilation cache on, a restarted
-        # campaign's "cold" cells stop paying -- this is the evidence
-        from .ledger import fold_walls
-        cold, warm = fold_walls(fresh)
-        led.note_stats(cc["hits"], cc["misses"], cold_wall_s=cold,
-                       warm_wall_s=warm)
+        if resume and done:
+            with obs.bind(tr, reg):
+                resync_done_cells()
+        watchdog = robust.LeaseWatchdog(table, on_lease_expired,
+                                        poll_s=min(1.0, lease_s / 4))
+        hard_abort = None
+        cc_before = compile_cache.stats()
         try:
-            cc = dict(cc, ledger=led.stats())
-        except Exception:  # noqa: BLE001 - bookkeeping only
-            logger.warning("couldn't aggregate compile-ledger stats",
+            with robust.signal_scope(latch):
+                with tr.span("fleet.dispatch", cat="fleet",
+                             args={"id": campaign_id,
+                                   "cells": len(pending),
+                                   "workers": len(workers)}):
+                    watchdog.start()
+                    threads = [threading.Thread(
+                        target=worker_loop, args=(w,),
+                        name=f"jepsen fleet {w.id}") for w in workers]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        while t.is_alive():
+                            t.join(timeout=0.5)
+        except BaseException as e:  # noqa: BLE001 - finalize, rethrow
+            hard_abort = e
+            if not latch.is_set():
+                latch.set(repr(e))
+            logger.warning("fleet campaign %s hard-aborted (%r); "
+                           "journal is resumable with --resume",
+                           campaign_id, e)
+        finally:
+            watchdog.stop()
+
+        unfinished = set(ids) - terminal
+        if unfinished and not latch.is_set():
+            # every worker died with cells left: surface it as an
+            # abort so the exit code and status say "incomplete", not
+            # "passed"
+            latch.set("workers-exhausted")
+            logger.warning("fleet campaign %s: workers exhausted with "
+                           "%d cell(s) unfinished", campaign_id,
+                           len(unfinished))
+
+        # compile reuse: the coordinator itself compiles nothing --
+        # sum THIS run's workers' deltas from their records (cells
+        # resumed from a prior process already reported theirs in that
+        # process's stats event; re-folding them would double-count on
+        # every --resume), then fold in the persisted ledger aggregate
+        recs = jr.latest()
+        fresh = [r for r in recs if str(r.get("cell")) not in done]
+        cc = {"hits": 0, "misses": 0}
+        for r in fresh:
+            w = r.get("compile-cache") or {}
+            cc["hits"] += int(w.get("hits") or 0)
+            cc["misses"] += int(w.get("misses") or 0)
+        local = compile_cache.delta(cc_before)
+        cc["hits"] += local["hits"]
+        cc["misses"] += local["misses"]
+        reg.set_gauge("campaign.compile_cache.hits", cc["hits"])
+        reg.set_gauge("campaign.compile_cache.misses", cc["misses"])
+        if led is not None:
+            # cold/warm compile wall: cells whose own delta had misses
+            # paid a compile (cold); all-hit cells rode the caches
+            # (warm). With the persistent jax compilation cache on, a
+            # restarted campaign's "cold" cells stop paying -- this is
+            # the evidence
+            from .ledger import fold_walls
+            cold, warm = fold_walls(fresh)
+            led.note_stats(cc["hits"], cc["misses"], cold_wall_s=cold,
+                           warm_wall_s=warm)
+            try:
+                cc = dict(cc, ledger=led.stats())
+            except Exception:  # noqa: BLE001 - bookkeeping only
+                logger.warning("couldn't aggregate compile-ledger "
+                               "stats", exc_info=True)
+        aborted = latch.is_set()
+        report = creport.summarize(
+            recs, meta={"id": campaign_id}, compile_cache=cc,
+            aborted=aborted, abort_reason=latch.reason,
+            skipped=len(done))
+        report["mode"] = "fleet"
+        report["workers"] = [w.id for w in workers]
+        jr.write_report(report)
+        try:
+            tr.dump(store.campaign_path(campaign_id, "trace.jsonl"))
+            tr.close_journal(remove=True)
+            store._dump_json(reg.snapshot(),
+                             store.campaign_path(campaign_id,
+                                                 "metrics.json"))
+            reg.close_journal(remove=True)
+        except Exception:  # noqa: BLE001 - telemetry is a byproduct
+            logger.warning("couldn't write fleet obs artifacts",
                            exc_info=True)
-    aborted = latch.is_set()
-    report = creport.summarize(
-        recs, meta={"id": campaign_id}, compile_cache=cc,
-        aborted=aborted, abort_reason=latch.reason, skipped=len(done))
-    report["mode"] = "fleet"
-    report["workers"] = [w.id for w in workers]
-    jr.write_report(report)
-    try:
-        tr.dump(store.campaign_path(campaign_id, "trace.jsonl"))
-        store._dump_json(reg.snapshot(),
-                         store.campaign_path(campaign_id,
-                                             "metrics.json"))
-    except Exception:  # noqa: BLE001 - telemetry is a byproduct
-        logger.warning("couldn't write fleet obs artifacts",
-                       exc_info=True)
-    jr.write_meta({**(jr.load_meta() or {}),
-                   "status": "aborted" if aborted else "complete",
-                   "updated": store.local_time()})
-    if hard_abort is not None:
-        raise hard_abort
-    return report
+        if trace_merge:
+            # fold every mirrored run trace + the coordinator's own
+            # into ONE Perfetto timeline, worker clocks normalized
+            # from the lease handshakes recorded above. Contained: a
+            # merge failure costs the merged view, never the campaign
+            try:
+                from ..obs import merge as obs_merge
+                minfo = obs_merge.merge_campaign(campaign_id)
+                report["trace"] = {k: minfo[k] for k in
+                                   ("path", "events", "cells",
+                                    "skipped")}
+                report["trace"]["workers"] = minfo["workers"]
+                jr.write_report(report)
+                logger.info("merged campaign trace: %d events, %d "
+                            "cells (%d skipped) -> %s",
+                            minfo["events"], minfo["cells"],
+                            minfo["skipped"], minfo["path"])
+            except Exception:  # noqa: BLE001
+                logger.warning("couldn't merge the campaign trace",
+                               exc_info=True)
+        jr.write_meta({**(jr.load_meta() or {}),
+                       "status": "aborted" if aborted else "complete",
+                       "updated": store.local_time()})
+        if hard_abort is not None:
+            raise hard_abort
+        return report
+    finally:
+        # always: stop serving this campaign's live gauges and stop
+        # the journal flusher threads, whatever path exits. On the
+        # happy path the dumps above already closed the journals
+        # (remove=True) and these are no-ops; on an exceptional exit
+        # the journal FILES are kept -- they are the crash evidence.
+        fservice.unregister_metrics_source(metrics_source)
+        tr.close_journal()
+        reg.close_journal()

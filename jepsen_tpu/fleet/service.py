@@ -50,7 +50,9 @@ logger = logging.getLogger(__name__)
 __all__ = ["MAX_BODY_BYTES", "ApiError", "Admission",
            "DEFAULT_BUDGETS", "authorize", "admission", "configure",
            "check_history", "submit_campaign", "campaign_status",
-           "latch", "drain", "shutdown", "reset"]
+           "latch", "drain", "shutdown", "reset",
+           "register_metrics_source", "unregister_metrics_source",
+           "metrics_text"]
 
 #: request-body ceiling enforced by web.Handler BEFORE reading
 MAX_BODY_BYTES = 16 << 20
@@ -134,6 +136,34 @@ class Admission:
         self._cond = threading.Condition()
         self._draining = False
         self._callers = {}
+        self._shed = 0
+
+    def _shed_one(self, err):
+        """Count one shed/refused admission (429/503) and rethrow —
+        the ``admission.shed_total`` series /api/metrics exposes."""
+        with self._cond:
+            self._shed += 1
+        raise err
+
+    @property
+    def shed_count(self):
+        with self._cond:
+            return self._shed
+
+    def gauges(self):
+        """The live admission state as metric series (the
+        ``admission.*`` family /api/metrics renders)."""
+        with self._cond:
+            return {
+                "admission.active_checks": sum(
+                    st["active"] for st in self._callers.values()),
+                "admission.queue_depth": sum(
+                    st["waiting"] for st in self._callers.values()),
+                "admission.campaigns": sum(
+                    st["campaigns"] for st in self._callers.values()),
+                "admission.callers": len(self._callers),
+                "admission.draining": int(self._draining),
+            }
 
     def _state(self, caller):
         return self._callers.setdefault(str(caller), {
@@ -210,11 +240,11 @@ class Admission:
                     st["day"], st["ops"] = day, 0
                 if st["ops"] + ops > quota:
                     nxt = (day + 1) * 86400 - time.time()
-                    raise ApiError(
+                    self._shed_one(ApiError(
                         429, f"daily op quota exhausted "
                              f"({st['ops']}/{quota} used, "
                              f"{ops} requested)",
-                        retry_after=min(86400, max(1, nxt)))
+                        retry_after=min(86400, max(1, nxt))))
 
             check_quota()
             # a None budget means unlimited, for every key -- the
@@ -226,19 +256,19 @@ class Admission:
                 left = deadline - time.monotonic()
                 if (qdepth is not None and st["waiting"] >= qdepth) \
                         or left <= 0:
-                    raise ApiError(
+                    self._shed_one(ApiError(
                         429, "concurrent check budget exhausted "
                              f"({st['active']} in flight, "
                              f"{st['waiting']} queued)",
-                        retry_after=2)
+                        retry_after=2))
                 st["waiting"] += 1
                 try:
                     self._cond.wait(timeout=left)
                 finally:
                     st["waiting"] -= 1
             if self._draining:
-                raise ApiError(503, "service is draining",
-                               retry_after=30)
+                self._shed_one(ApiError(503, "service is draining",
+                                        retry_after=30))
             # cond.wait released the lock, so sibling waiters may
             # have spent the quota meanwhile: re-check before charging
             check_quota()
@@ -253,14 +283,14 @@ class Admission:
         the campaign thread finishes); 429 past the budget."""
         with self._cond:
             if self._draining:
-                raise ApiError(503, "service is draining",
-                               retry_after=30)
+                self._shed_one(ApiError(503, "service is draining",
+                                        retry_after=30))
             st = self._state(caller)
             limit = self.budgets["campaigns"]
             if limit is not None and st["campaigns"] >= limit:
-                raise ApiError(
+                self._shed_one(ApiError(
                     429, f"campaign budget exhausted ({limit} "
-                         "queued or running)", retry_after=30)
+                         "queued or running)", retry_after=30))
             st["campaigns"] += 1
 
     def campaign_done(self, caller):
@@ -361,6 +391,87 @@ def reset():
         _latch = None
         _admission = None
         _campaigns.clear()
+        _metrics_sources.clear()
+
+
+# ---------------------------------------------------------------------------
+# GET /api/metrics: Prometheus text exposition
+
+_metrics_sources = {}
+
+
+def register_metrics_source(name, fn):
+    """Register a live metrics provider for ``GET /api/metrics``.
+    ``fn()`` returns an obs.Registry or a structured section dict
+    (see obs.metrics.render_prometheus) — the fleet dispatcher
+    registers its lease-table/queue gauges here for the duration of a
+    campaign. Returns the name (pass to `unregister_metrics_source`)."""
+    with _lock:
+        _metrics_sources[str(name)] = fn
+    return str(name)
+
+
+def unregister_metrics_source(name):
+    with _lock:
+        _metrics_sources.pop(str(name), None)
+
+
+def _ledger_section():
+    """The compile-ledger / persistent-jax-cache family: cross-process
+    hit/miss counts plus the cold/warm compile wall split the jax
+    cache's warm restarts shrink."""
+    from . import ledger as fledger
+    led = fledger.attached()
+    if led is None:
+        return None
+    st = led.stats()
+    return {"counters": {"ledger.hits": st.get("hits", 0),
+                         "ledger.misses": st.get("misses", 0)},
+            "gauges": {"ledger.shapes": st.get("shapes", 0),
+                       "ledger.processes": st.get("processes", 0),
+                       "ledger.cold_wall_s": st.get("cold_wall_s", 0.0),
+                       "ledger.warm_wall_s": st.get("warm_wall_s",
+                                                    0.0)}}
+
+
+def metrics_text():
+    """The ``GET /api/metrics`` body: the bound obs Registry (the
+    in-process run/campaign, when one is live), every registered
+    source (fleet dispatch gauges), the admission gate's live state,
+    and the compile-ledger aggregate — rendered in the Prometheus
+    text exposition format. Sources that fail are skipped, never
+    5xx'd: a metrics scrape must not depend on every subsystem being
+    healthy (that is what it is for)."""
+    from .. import obs
+
+    sections = []
+    reg = obs.registry()
+    if reg is not None:
+        sections.append(reg)
+    with _lock:
+        sources = list(_metrics_sources.items())
+    for name, fn in sources:
+        try:
+            section = fn()
+            if isinstance(section, (list, tuple)):
+                sections.extend(s for s in section if s is not None)
+            elif section is not None:
+                sections.append(section)
+        except Exception:  # noqa: BLE001 - scrape over perfection
+            logger.warning("metrics source %s failed", name,
+                           exc_info=True)
+    adm = admission()
+    sections.append({"gauges": adm.gauges(),
+                     "counters": {"admission.shed_total":
+                                  adm.shed_count}})
+    try:
+        led = _ledger_section()
+        if led is not None:
+            sections.append(led)
+    except Exception:  # noqa: BLE001
+        logger.warning("ledger metrics section failed", exc_info=True)
+    from ..obs import render_prometheus
+    return render_prometheus(sections)
 
 
 # ---------------------------------------------------------------------------

@@ -140,6 +140,9 @@ def pull_run(conn, remote_dir, dest, *, timeout_s=DEFAULT_SYNC_TIMEOUT_S,
     def attempt():
         nonlocal attempts
         attempts += 1
+        from .. import obs
+        obs.instant("fleet.sync.attempt", cat="fleet",
+                    attempt=attempts, dir=str(remote_dir)[-120:])
         if os.path.isdir(dest):     # raced another syncer: their copy won
             return {"files": 0, "bytes": 0, "already": True}
         man = manifest(conn, remote_dir, timeout_s=left())

@@ -82,15 +82,29 @@ def _die_once(marker):
 def run_cell_spec(spec):
     """Build and run one cell from its spec; returns the journal-shaped
     record. Crashes are contained into outcome "crashed" -- the worker
-    must always produce a parseable result if it survives at all."""
+    must always produce a parseable result if it survives at all.
+
+    Telemetry plane: the spec's ``trace`` block (minted by the
+    dispatcher) is bound into the run as ``test["obs-context"]``, so
+    every span and metric this process emits carries {campaign, cell,
+    worker} -- and the worker stamps its OWN wall clock at spec
+    receipt and result print (``rec["clock"]``), the two worker-side
+    legs of the handshake ``obs.merge`` normalizes clock skew with."""
     from .. import core, store
     from ..campaign import compile_cache
 
+    received_epoch = time.time()
     cid = spec.get("cell")
     params = dict(spec.get("params") or {})
+    tctx = spec.get("trace") or {}
     rec = {"cell": cid, "group": spec.get("group") or cid,
            "params": params, "worker": spec.get("worker"),
-           "pid": os.getpid()}
+           "pid": os.getpid(),
+           "clock": {"worker-received-epoch": received_epoch,
+                     **({"coord-sent-epoch":
+                         tctx["coord-sent-epoch"]}
+                        if tctx.get("coord-sent-epoch") is not None
+                        else {})}}
     t0 = time.monotonic()
     test = None
     try:
@@ -117,6 +131,15 @@ def run_cell_spec(spec):
         test.setdefault("campaign", {}).update(
             {"id": spec.get("campaign"), "cell": cid, "params": params,
              "worker": spec.get("worker")})
+        # bind the campaign trace context into obs: the run's tracer
+        # anchors trace_meta with it and the registry labels every
+        # metric, so the mirrored artifacts merge attributably
+        test.setdefault("obs-context", {
+            "campaign": spec.get("campaign"), "cell": cid,
+            "worker": spec.get("worker")})
+        if options.get("telemetry-flush-ms") is not None:
+            test.setdefault("telemetry-flush-ms",
+                            options["telemetry-flush-ms"])
         tier = spec.get("backend")
         if tier:
             from . import backends as fbackends
@@ -143,6 +166,7 @@ def run_cell_spec(spec):
     except (AssertionError, AttributeError, KeyError, TypeError):
         rec["path"] = None
     rec["wall_s"] = round(time.monotonic() - t0, 3)
+    rec["clock"]["worker-result-epoch"] = time.time()
     return rec
 
 

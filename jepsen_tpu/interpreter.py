@@ -67,6 +67,12 @@ DEFAULT_ABORT_GRACE_S = 10.0
 #: bounded join for live (idle) workers at shutdown
 WORKER_JOIN_TIMEOUT_S = 10.0
 
+#: completions between folds of the locally-batched per-op metrics
+#: into the obs registry (see the fold in `_run`): small enough that
+#: journal staleness stays far below the telemetry flush cadence,
+#: large enough that the per-op hot path never pays a facade call
+_OBS_FOLD_OPS = 64
+
 #: bounded join for zombie (wedged) workers -- they will almost never
 #: exit; this is a courtesy poll before counting them leaked
 ZOMBIE_JOIN_TIMEOUT_S = 0.05
@@ -301,6 +307,26 @@ def _run(test):
     # per-thread invoke timestamps (tracer clock) for the invoke->
     # complete op spans; at most one op is outstanding per thread
     inflight = {}
+    # per-op metrics fold: the registry facade costs microseconds per
+    # call and every call here rides the serial hot loop, so counters
+    # and latency observations accumulate in plain locals and fold
+    # every _OBS_FOLD_OPS completions (and at every abort/exit edge).
+    # Totals are exact; metrics-journal staleness stays bounded well
+    # below the telemetry flush cadence. Trace spans are NOT batched —
+    # every op still gets its event the moment it completes.
+    obs_lat = []
+    obs_counts = {}     # (counter, type-or-None, f) -> n
+
+    def fold_obs():
+        for (cname, ty, f), n in obs_counts.items():
+            if ty is None:
+                obs.inc(cname, n, f=f)
+            else:
+                obs.inc(cname, n, type=ty, f=f)
+        obs_counts.clear()
+        if obs_lat:
+            obs.observe_many("interpreter.op_latency_s", obs_lat)
+            obs_lat.clear()
 
     def record(op):
         history.append(op)
@@ -327,16 +353,17 @@ def _run(test):
             if start is not None:
                 t1 = obs.now_ns()
                 obs.complete(
-                    f"{op2.get('f')}", start, t1 - start,
+                    str(op2.get("f")), start, t1 - start,
                     cat="op", tid=_trace_tid(thread),
                     process=op2.get("process"),
                     type=op2.get("type"))
-                obs.observe("interpreter.op_latency_s",
-                            (t1 - start) / 1e9)
+                obs_lat.append((t1 - start) / 1e9)
             if goes_in_history(op2):
-                obs.inc("interpreter.ops_completed",
-                        type=str(op2.get("type")),
-                        f=str(op2.get("f")))
+                k = ("interpreter.ops_completed",
+                     str(op2.get("type")), str(op2.get("f")))
+                obs_counts[k] = obs_counts.get(k, 0) + 1
+            if len(obs_lat) >= _OBS_FOLD_OPS:
+                fold_obs()
         g = gen.gen_update(g, test, ctx, op2)
         if thread != gen.NEMESIS and op2.get("type") == "info":
             ctx = ctx.with_worker(thread, ctx.next_process(thread))
@@ -364,6 +391,7 @@ def _run(test):
         process_completion(out)
 
     def finish():
+        fold_obs()
         if watchdog is not None:
             watchdog.stop()
         _stop_workers(list(workers.values()), zombies)
@@ -426,9 +454,11 @@ def _run(test):
                 logger.warning(
                     "Abort (%s): no new ops; draining %d outstanding "
                     "op(s) for up to %.0fs", reason, outstanding, grace_s)
+                fold_obs()
                 obs.inc("robust.aborts", reason=reason)
                 obs.instant("interpreter.abort", cat="lifecycle",
                             reason=reason, outstanding=outstanding)
+                obs.flush()
 
             if drain_deadline is not None:
                 if outstanding == 0:
@@ -481,7 +511,9 @@ def _run(test):
                     watchdog.arm(thread, serial, op)
             if obs.enabled() and op.get("type") == "invoke":
                 inflight[thread] = obs.now_ns()
-                obs.inc("interpreter.ops_invoked", f=str(op.get("f")))
+                k = ("interpreter.ops_invoked", None,
+                     str(op.get("f")))
+                obs_counts[k] = obs_counts.get(k, 0) + 1
             ctx = ctx.with_time(op["time"]).busy(thread)
             g = gen.gen_update(g2, test, ctx, op)
             if goes_in_history(op):
@@ -490,6 +522,7 @@ def _run(test):
             poll_timeout = 0.0
     except BaseException:  # noqa: BLE001 - workers must exit on ANY abort
         logger.info("Shutting down workers after abnormal exit")
+        fold_obs()
         if watchdog is not None:
             watchdog.stop()
         # bounded: a wedged worker is abandoned and counted, never joined

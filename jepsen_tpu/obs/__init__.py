@@ -30,15 +30,18 @@ import contextlib
 import threading
 import time as _time
 
-from .metrics import DEFAULT_LATENCY_BUCKETS_S, Histogram, Registry
-from .trace import Tracer, current_span, load_trace
+from .metrics import (DEFAULT_LATENCY_BUCKETS_S, Histogram, Registry,
+                      load_metrics_journal, render_prometheus)
+from .trace import Tracer, current_span, load_trace, trace_meta
 
 __all__ = [
     "Tracer", "Registry", "Histogram", "DEFAULT_LATENCY_BUCKETS_S",
     "bind", "run_scope", "tracer", "registry", "enabled", "current_span",
-    "load_trace", "span", "instant", "complete", "counter_track",
+    "load_trace", "trace_meta", "load_metrics_journal",
+    "render_prometheus", "span", "instant", "complete", "counter_track",
     "window_start", "window_end", "name_thread", "now_ns", "inc",
-    "set_gauge", "max_gauge", "observe", "gen_event",
+    "set_gauge", "max_gauge", "observe", "observe_many", "gen_event",
+    "flush",
 ]
 
 _lock = threading.Lock()
@@ -100,11 +103,19 @@ def run_scope(test):
     """The per-test-run binding `core.run` uses: creates a fresh tracer
     + registry (unless ``test["obs?"]`` is falsy), parks them in
     ``test["obs"]`` so store.write_obs can persist them, and binds them
-    for the run's duration."""
+    for the run's duration.
+
+    ``test["obs-context"]`` (set by the campaign scheduler / fleet
+    worker: ``{campaign, cell, worker}``) becomes the tracer's
+    trace_meta context AND the registry's default labels, so every
+    span and metric the run emits stays attributable after the
+    campaign-level merge."""
     if not test.get("obs?", True):
         test.pop("obs", None)
         return contextlib.nullcontext((None, None))
-    tr, reg = Tracer(), Registry()
+    ctx = test.get("obs-context")
+    tr = Tracer(context=ctx)
+    reg = Registry(default_labels=ctx)
     test["obs"] = {"tracer": tr, "registry": reg}
     return bind(tr, reg)
 
@@ -162,6 +173,17 @@ def name_thread(tid, name):
         tr.name_thread(tid, name)
 
 
+def flush(force_metrics=True):
+    """Force the bound sinks' journals to disk (no-op when unbound or
+    unjournaled): the facade for code that just produced something a
+    crash must not lose."""
+    tr, reg = _tracer, _registry
+    if tr is not None:
+        tr.flush_journal()
+    if reg is not None and force_metrics:
+        reg.journal_now()
+
+
 def gen_event(tag, kind, payload):
     """The generator.trace combinator's tap: one instant event per
     traced op/update, alongside its existing log line. The repr is
@@ -198,3 +220,10 @@ def observe(name, value, buckets=None, **labels):
     reg = _registry
     if reg is not None:
         reg.observe(name, value, buckets=buckets, **labels)
+
+
+def observe_many(name, values, buckets=None, **labels):
+    """Batch form of `observe`: one lock acquisition for the lot."""
+    reg = _registry
+    if reg is not None:
+        reg.observe_many(name, values, buckets=buckets, **labels)

@@ -1,0 +1,221 @@
+"""Campaign trace merge: fold N per-run traces into ONE Perfetto
+timeline with a process lane per worker and normalized clocks.
+
+A fleet campaign's story is scattered across the coordinator's own
+``store/campaigns/<id>/trace.jsonl`` (dispatch, leases, syncs) and one
+``trace.jsonl`` per cell run, each timestamped against its OWN
+process's monotonic clock — and, for remote workers, its own wall
+clock. This module reassembles them:
+
+* **Anchoring.** Every tracer stamps a ``trace_meta`` event with the
+  wall epoch (``epoch_ns``) its ts=0 corresponds to, so a run's
+  relative microseconds map onto that host's wall clock.
+* **Skew normalization.** Worker wall clocks are NOT trusted. The
+  lease handshake records four stamps — the coordinator's send time,
+  the worker's spec-receipt time, the worker's result-print time, the
+  coordinator's result-receipt time (``rec["clock"]``, journaled on
+  the outcome record). The two legs bound the offset:
+
+      worker_done - coord_recv  <=  offset  <=  worker_recv - coord_sent
+
+  but they are wildly ASYMMETRIC here: the forward leg contains the
+  worker interpreter's boot and the box's scheduling delay (seconds
+  under load — measured +6 s on a busy 2-core host), while the return
+  leg is print -> process-exit -> parse (tens of ms). The classic
+  symmetric midpoint would split that boot time into a fake seconds-
+  scale offset for a LOOPBACK worker, so the estimate uses the tight
+  return leg alone: ``offset = worker_done - coord_recv``, biased by
+  only the return latency. The per-worker offset is the median over
+  that worker's cells, and every event of that worker's runs is
+  shifted by it onto the coordinator's clock. This is what makes
+  reported detection latencies honest across hosts (the monitoring
+  papers' metric — arxiv 2509.17795, 2410.04581 — is meaningless
+  under uncorrected skew).
+* **Lanes.** The merged trace remaps ``pid``: lane 1 is the
+  coordinator, lanes 2.. are workers (sorted by id), each named via a
+  ``process_name`` metadata event — Perfetto renders one process
+  track per worker with the original thread tracks nested inside.
+* **Determinism.** Events are sorted by (ts, lane, tid, ph, name) and
+  serialized with sorted keys: the same inputs produce a byte-identical
+  ``campaign_trace.jsonl`` (the merge-twice test pins this), so the
+  artifact is diffable across resumes.
+
+Runs whose ``trace.jsonl`` never finalized fall back to the
+incremental ``trace.jsonl.journal`` (torn tail dropped) — a kill -9'd
+worker still contributes everything up to the kill. Runs whose
+artifacts were never mirrored home (``synced: false``) are skipped and
+counted in the summary, not fatal: planlint PL017 warns ahead of time
+when a merge is requested with artifact sync off.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import statistics
+
+from .trace import load_trace, trace_meta
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["MERGED_TRACE_FILE", "worker_offsets", "clock_offset",
+           "merge_campaign"]
+
+MERGED_TRACE_FILE = "campaign_trace.jsonl"
+
+
+def clock_offset(clock):
+    """The worker-minus-coordinator wall offset (seconds) from one
+    lease handshake, or None when the needed stamps are missing.
+
+    Return-leg estimate: the worker's result stamp measures
+    ``offset - d2`` against the coordinator's receipt stamp, where d2
+    is the result's print -> exit -> parse latency (tens of ms). The
+    forward leg is deliberately NOT averaged in — it contains the
+    worker interpreter's boot and scheduling delay (seconds under
+    load), and the symmetric midpoint would hand a loopback worker a
+    fake seconds-scale offset (see the module docstring)."""
+    if not isinstance(clock, dict):
+        return None
+    try:
+        wd = float(clock["worker-result-epoch"])
+        cr = float(clock["coord-received-epoch"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    return wd - cr
+
+
+def worker_offsets(records):
+    """{worker_id: offset_s} — the median handshake offset per worker
+    over its cell records. Workers with no usable handshake get 0.0
+    (loopback workers share the coordinator's clock anyway)."""
+    samples = {}
+    for rec in records:
+        off = clock_offset(rec.get("clock"))
+        if off is None:
+            continue
+        samples.setdefault(str(rec.get("worker")), []).append(off)
+    return {w: statistics.median(s) for w, s in samples.items()}
+
+
+def _load_run_events(run_dir):
+    """A run dir's trace events (finalized file or journal fallback);
+    [] when neither exists."""
+    for name in ("trace.jsonl", "trace.jsonl.journal"):
+        p = os.path.join(str(run_dir), name)
+        if os.path.exists(p):
+            try:
+                return load_trace(p)
+            except OSError:
+                return []
+    return []
+
+
+def _lane_meta(lane, name):
+    return {"name": "process_name", "ph": "M", "cat": "__metadata",
+            "ts": 0.0, "pid": lane, "tid": 0,
+            "args": {"name": str(name)}}
+
+
+def _shift(events, lane, shift_us):
+    """Re-lane and re-clock one trace's events; trace_meta is dropped
+    (its anchor is consumed here) and thread-name metadata keeps
+    ts=0."""
+    out = []
+    for ev in events:
+        if ev.get("name") == "trace_meta":
+            continue
+        ev = dict(ev)
+        ev["pid"] = lane
+        if ev.get("ph") != "M":
+            try:
+                ev["ts"] = round(float(ev.get("ts", 0.0)) + shift_us, 3)
+            except (TypeError, ValueError):
+                ev["ts"] = 0.0
+        out.append(ev)
+    return out
+
+
+def _sort_key(ev):
+    return (float(ev.get("ts", 0.0)) if ev.get("ph") != "M" else -1.0,
+            int(ev.get("pid", 0)), str(ev.get("tid", "")),
+            str(ev.get("ph", "")), str(ev.get("name", "")))
+
+
+def merge_campaign(campaign_id, out_path=None):
+    """Merge one campaign's traces into
+    ``store/campaigns/<id>/campaign_trace.jsonl``. Returns a summary
+    dict: event count, per-worker lane/offset/cell counts, runs
+    skipped for missing artifacts. Raises FileNotFoundError for an
+    unknown campaign; everything per-run is contained."""
+    from .. import store
+
+    meta = None
+    try:
+        with open(store.campaign_path(campaign_id,
+                                      "campaign.json")) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        raise FileNotFoundError(
+            f"campaign {campaign_id!r} has no campaign.json") from None
+    records = store.latest_campaign_records(campaign_id)
+    offsets = worker_offsets(records)
+
+    # -- coordinator lane ----------------------------------------------
+    coord_events = _load_run_events(store.campaign_path(campaign_id))
+    coord_meta = trace_meta(coord_events) or {}
+    coord_epoch_ns = coord_meta.get("epoch_ns")
+    merged = [_lane_meta(1, "coordinator")]
+    merged += _shift(coord_events, 1, 0.0)
+
+    # -- one lane per worker -------------------------------------------
+    workers = sorted({str(r.get("worker") or "local") for r in records})
+    lanes = {w: i + 2 for i, w in enumerate(workers)}
+    for w in workers:
+        merged.append(_lane_meta(lanes[w], f"worker {w}"))
+
+    skipped = 0
+    cells_merged = {w: 0 for w in workers}
+    for rec in sorted(records, key=lambda r: str(r.get("cell"))):
+        run_dir = rec.get("path")
+        if not run_dir or not os.path.isdir(str(run_dir)):
+            skipped += 1
+            continue
+        events = _load_run_events(run_dir)
+        if not events:
+            skipped += 1
+            continue
+        w = str(rec.get("worker") or "local")
+        run_meta = trace_meta(events) or {}
+        run_epoch_ns = run_meta.get("epoch_ns")
+        off_s = offsets.get(w, 0.0)
+        if run_epoch_ns is None or coord_epoch_ns is None:
+            # no anchor (pre-plane trace): place at the coordinator's
+            # origin, un-normalized but visible
+            shift_us = 0.0
+        else:
+            shift_us = (run_epoch_ns - off_s * 1e9
+                        - coord_epoch_ns) / 1e3
+        merged += _shift(events, lanes[w], shift_us)
+        cells_merged[w] += 1
+
+    merged.sort(key=_sort_key)
+    out_path = out_path or store.campaign_path(campaign_id,
+                                               MERGED_TRACE_FILE)
+    d = os.path.dirname(out_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("[\n")
+        for ev in merged:
+            f.write(json.dumps(ev, sort_keys=True) + ",\n")
+    os.replace(tmp, out_path)
+    return {"path": out_path, "events": len(merged),
+            "cells": len(records) - skipped, "skipped": skipped,
+            "workers": {w: {"lane": lanes[w],
+                            "cells": cells_merged[w],
+                            "offset_s": round(offsets.get(w, 0.0), 6)}
+                        for w in workers},
+            "status": (meta or {}).get("status")}

@@ -117,6 +117,17 @@ class SearchObs:
                        args=fields)
             if track:
                 tr.counter(f"wgl.{engine}", track, cat="search")
+        # push the journals' buffered tail to disk NOW: heartbeats
+        # used to be snapshot-at-end only, so a wedged search the
+        # watchdog killed left no trace of how far it got. With the
+        # incremental journals attached (store.open_obs_journals) the
+        # last heartbeat before the kill is always readable. One
+        # flush per host->device dispatch (~seconds apart): noise
+        # next to the device sync it rides behind.
+        if tr is not None:
+            tr.flush_journal()
+        if reg is not None:
+            reg.journal_now()
 
     def summary(self, engine, result, keys=None, shard_explored=None):
         """Record a finished search's telemetry from its result dict."""

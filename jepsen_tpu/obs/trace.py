@@ -28,6 +28,22 @@ Timestamps are microseconds relative to the tracer's creation
 propagates through a contextvar stack, so `contextvars.copy_context()`
 — which the interpreter's worker spawn and control's on_nodes fan-out
 already use — carries the parent span across threads for free.
+
+Two additions for the FLEET telemetry plane (doc/observability.md):
+
+* **Wall-clock anchor + context.** Every tracer records the wall epoch
+  (``time.time_ns``) at creation and an optional ``context`` mapping
+  ({campaign, cell, worker} for fleet runs). Both ride in a
+  ``trace_meta`` metadata event at the head of the dump/journal, which
+  is what lets ``obs.merge`` place per-worker traces on one normalized
+  timeline and attribute whole files to their campaign cell without
+  per-event label bloat.
+* **Crash-safe journal.** `attach_journal` mirrors every event to an
+  append+flush journal file (``trace.jsonl.journal``, the
+  store.HistoryJournal discipline): a kill -9'd process leaves
+  everything up to the kill on disk, torn final line dropped on read.
+  `dump()` stays the atomic finalize; once it succeeds the caller
+  retires the journal (`close_journal(remove=True)`).
 """
 
 from __future__ import annotations
@@ -58,14 +74,33 @@ def current_span():
 class Tracer:
     """Collects Chrome-trace events; `dump(path)` persists them."""
 
-    def __init__(self, max_events=MAX_EVENTS):
+    def __init__(self, max_events=MAX_EVENTS, context=None):
         self._events = []
         self._lock = threading.Lock()
         self._t0 = _time.monotonic_ns()
+        #: wall-clock anchor for cross-process merging: the wall time
+        #: this tracer's ts=0 corresponds to (best effort -- a time
+        #: nemesis stepping the wall clock skews it, which is exactly
+        #: what the merge's handshake-based normalization corrects)
+        self.epoch_ns = _time.time_ns()
+        self.context = dict(context or {})
         self._pid = os.getpid()
         self._named_tids = set()
         self._max_events = max_events
         self.dropped = 0
+        self._journal = None
+        self._journal_path = None
+        self._journal_flush_s = 0.0
+        self._journal_last = 0.0
+        self._journal_stop = None
+        #: serialized forms of ``_events[:len(_ser)]`` — filled lazily
+        #: in batches by `_serialized_upto` (events are never mutated
+        #: after _emit, so deferring is safe). Each event is JSON-
+        #: encoded exactly ONCE and the string is shared by the
+        #: journal's incremental appends and the final dump().
+        self._ser = []
+        #: how many events the journal has on disk already
+        self._journal_written = 0
 
     # -- clock ----------------------------------------------------------
 
@@ -76,11 +111,154 @@ class Tracer:
     # -- raw emission ---------------------------------------------------
 
     def _emit(self, ev):
+        # lock-free: CPython's list.append is atomic, and the
+        # serialization cache / journal / dump only ever read a
+        # length-prefix snapshot taken under the lock. The cap check
+        # may overshoot by a few racing events (it is a memory guard,
+        # not a contract) and a racing dropped count may undercount —
+        # both harmless, and the hot path pays one append.
+        if len(self._events) >= self._max_events:
+            self.dropped += 1
+            return
+        self._events.append(ev)
+        # flush_s <= 0 = synchronous per-event durability; with a
+        # positive interval the background flusher owns the writes.
+        # The unlocked peek is a fast-path filter only -- the journal
+        # handle is re-checked under the lock, so a close racing this
+        # emit can't flush into a None/closed file
+        if self._journal is not None and self._journal_flush_s <= 0:
+            with self._lock:
+                if self._journal is not None:
+                    self._journal_flush_locked(_time.monotonic())
+
+    def _serialized_upto(self, n):
+        """Extend the one-shot serialization cache to cover the first
+        ``n`` events and return it (lock held)."""
+        ser, events = self._ser, self._events
+        while len(ser) < n:
+            ser.append(json.dumps(events[len(ser)],
+                                  separators=(",", ":")))
+        return ser
+
+    # -- crash-safe journal ---------------------------------------------
+
+    def meta_event(self):
+        """The ``trace_meta`` metadata event: wall epoch + context.
+        Written at the head of every dump/journal (never buffered, so
+        it doesn't count against the event cap)."""
+        ev = self._base("trace_meta", "i", "__metadata", 0, self._pid)
+        ev["s"] = "g"
+        ev["args"] = {"epoch_ns": self.epoch_ns}
+        if self.context:
+            ev["args"]["context"] = dict(self.context)
+        return ev
+
+    def attach_journal(self, path, flush_s=0.5):
+        """Start mirroring events to an incremental journal at ``path``
+        (one JSON line per event, HistoryJournal discipline): already
+        buffered events are backfilled, then every `_emit` enqueues.
+        The hot path pays one list append; serialization + write +
+        flush happen in batches at most every ``flush_s`` seconds
+        (<= 0 = every event); `flush_journal` forces one. Failures are
+        contained -- the journal is crash insurance, never
+        load-bearing."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
         with self._lock:
-            if len(self._events) >= self._max_events:
-                self.dropped += 1
+            self.close_journal_locked()
+            try:
+                f = open(path, "w")
+                n = len(self._events)
+                f.write(json.dumps(self.meta_event()) + "\n")
+                f.write("".join(s + "\n"
+                                for s in self._serialized_upto(n)[:n]))
+                f.flush()
+            except OSError:
+                return None
+            self._journal = f
+            self._journal_path = path
+            self._journal_written = n
+            self._journal_flush_s = max(0.0, float(flush_s))
+            self._journal_last = _time.monotonic()
+            if self._journal_flush_s > 0:
+                stop = self._journal_stop = threading.Event()
+                threading.Thread(
+                    target=self._journal_loop, args=(stop,),
+                    name="obs-trace-journal", daemon=True).start()
+            return path
+
+    def _journal_loop(self, stop):
+        """Background flusher: every flush interval, serialize + write
+        whatever the hot path appended since the last pass. Keeps the
+        emit path to a single list append and — unlike the old
+        on-mutation check — flushes the tail even while the tracer is
+        idle (a wedged run's last events still reach disk)."""
+        while not stop.wait(self._journal_flush_s):
+            with self._lock:
+                if self._journal is None or self._journal_stop is not stop:
+                    return
+                self._journal_flush_locked(_time.monotonic())
+
+    def _journal_flush_locked(self, now):
+        """Serialize + append everything not yet on disk, then flush
+        (lock held). A failed write drops the journal rather than the
+        run."""
+        try:
+            n = len(self._events)
+            if self._journal_written < n:
+                ser = self._serialized_upto(n)
+                self._journal.write("".join(
+                    s + "\n"
+                    for s in ser[self._journal_written:n]))
+                self._journal_written = n
+            self._journal.flush()
+            self._journal_last = now
+        except (OSError, ValueError):
+            self._journal = None
+
+    def journaling(self):
+        """True while an incremental journal is attached and healthy."""
+        return self._journal is not None
+
+    def flush_journal(self):
+        """Force the journal's buffered tail to disk (search
+        heartbeats call this: a wedged search killed by the watchdog
+        must leave its LAST heartbeat readable)."""
+        with self._lock:
+            if self._journal is None:
                 return
-            self._events.append(ev)
+            self._journal_flush_locked(_time.monotonic())
+
+    def close_journal_locked(self):
+        if self._journal_stop is not None:
+            self._journal_stop.set()
+            self._journal_stop = None
+        f, self._journal = self._journal, None
+        if f is not None:
+            try:
+                n = len(self._events)
+                if self._journal_written < n:
+                    ser = self._serialized_upto(n)
+                    f.write("".join(
+                        s + "\n"
+                        for s in ser[self._journal_written:n]))
+                    self._journal_written = n
+                f.close()
+            except (OSError, ValueError):  # pragma: no cover
+                pass
+
+    def close_journal(self, remove=False):
+        """Stop journaling; with ``remove``, delete the journal file
+        (the finalize step once the atomic dump exists)."""
+        with self._lock:
+            self.close_journal_locked()
+            path, self._journal_path = self._journal_path, None
+        if remove and path:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
     def _base(self, name, ph, cat, ts_ns, tid):
         if tid is None:
@@ -106,9 +284,13 @@ class Tracer:
                  args=None):
         """An ``X`` span with an externally measured start/duration (the
         interpreter measures op latency itself; the tracer just
-        records)."""
-        ev = self._base(name, "X", cat, ts_ns, tid)
-        ev["dur"] = max(0, dur_ns) / 1e3
+        records). Built as one dict literal — this is the per-op hot
+        path and `_base` + mutation costs a measurable fraction of a
+        noop op."""
+        ev = {"name": name, "ph": "X", "cat": cat, "ts": ts_ns / 1e3,
+              "pid": self._pid,
+              "tid": threading.get_ident() if tid is None else tid,
+              "dur": dur_ns / 1e3 if dur_ns > 0 else 0.0}
         if args:
             ev["args"] = args
         self._emit(ev)
@@ -176,22 +358,25 @@ class Tracer:
         the dropped count) — a silently truncated trace reads as
         "activity stopped here", which is exactly the wrong conclusion
         during a stall diagnosis."""
-        events = self.events()
+        with self._lock:
+            n = len(self._events)
+            lines = [json.dumps(self.meta_event(),
+                                separators=(",", ":"))]
+            lines += self._serialized_upto(n)[:n]
         if self.dropped:
             ev = self._base("trace_truncated", "i", "__metadata",
                             self.now_ns(), self._pid)
             ev["s"] = "g"
             ev["args"] = {"dropped_events": self.dropped,
                           "max_events": self._max_events}
-            events.append(ev)
+            lines.append(json.dumps(ev, separators=(",", ":")))
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             f.write("[\n")
-            for ev in events:
-                f.write(json.dumps(ev) + ",\n")
+            f.write("".join(s + ",\n" for s in lines))
         os.replace(tmp, path)
         return path
 
@@ -199,12 +384,29 @@ class Tracer:
 def load_trace(path):
     """Parse a trace.jsonl back into a list of event dicts (tolerant of
     the leading ``[`` and trailing commas — i.e. exactly what dump
-    writes, and also plain one-object-per-line JSONL)."""
+    writes, and also plain one-object-per-line JSONL). Unparseable
+    lines are DROPPED with a warning, not fatal: an incremental
+    journal's torn final line (killed mid-append) must not make the
+    surviving telemetry unreadable."""
+    import logging
     events = []
     with open(path) as f:
         for line in f:
             line = line.strip().rstrip(",")
             if not line or line in ("[", "]"):
                 continue
-            events.append(json.loads(line))
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                logging.getLogger(__name__).warning(
+                    "dropping unparseable trace line in %s", path)
     return events
+
+
+def trace_meta(events):
+    """The ``trace_meta`` args of a loaded trace (epoch_ns + context),
+    or None for traces predating the anchor."""
+    for ev in events:
+        if ev.get("name") == "trace_meta":
+            return ev.get("args") or {}
+    return None

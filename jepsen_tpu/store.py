@@ -47,6 +47,18 @@ DEFAULT_NONSERIALIZABLE_KEYS = {
 #: line, appended as the run progresses; finalized into history.jsonl)
 JOURNAL_FILE = "history.jsonl.journal"
 
+#: incremental telemetry journals (same crash-only discipline as the
+#: history journal: appended+flushed as the run progresses, retired by
+#: the atomic trace.jsonl / metrics.json finalize) — what a kill -9'd
+#: worker leaves for the fleet's artifact sync to mirror home
+TRACE_JOURNAL_FILE = "trace.jsonl.journal"
+METRICS_JOURNAL_FILE = "metrics.json.journal"
+
+#: default telemetry journal flush interval, milliseconds (override
+#: per test with ``test["telemetry-flush-ms"]``; planlint PL017
+#: rejects non-positive values)
+DEFAULT_TELEMETRY_FLUSH_MS = 500.0
+
 #: directory under base_dir holding campaign state
 #: (``store/campaigns/<campaign-id>/campaign.json`` + ``cells.jsonl``
 #: + ``report.json``, written by jepsen_tpu.campaign.journal); the
@@ -264,11 +276,63 @@ def update_symlinks(test):
         update_symlink(test, dest)
 
 
-def write_obs(test):
+def telemetry_flush_s(test):
+    """The telemetry journal flush interval in seconds, from
+    ``test["telemetry-flush-ms"]`` (default 500 ms; invalid values
+    fall back to the default — planlint PL017 flags them ahead of
+    time)."""
+    ms = test.get("telemetry-flush-ms", DEFAULT_TELEMETRY_FLUSH_MS)
+    try:
+        ms = float(ms)
+    except (TypeError, ValueError):
+        ms = DEFAULT_TELEMETRY_FLUSH_MS
+    if ms <= 0 or isinstance(test.get("telemetry-flush-ms"), bool):
+        ms = DEFAULT_TELEMETRY_FLUSH_MS
+    return ms / 1000.0
+
+
+def open_obs_journals(test):
+    """Attach the incremental telemetry journals (trace.jsonl.journal
+    + metrics.json.journal in the run directory) to the run's bound
+    tracer/registry, so a kill -9 mid-run still leaves readable
+    telemetry — the HistoryJournal discipline applied to obs. No-op
+    for unnamed or obs-off tests; failures are contained (journals
+    are crash insurance, never load-bearing)."""
+    o = test.get("obs") or {}
+    tracer = o.get("tracer")
+    registry = o.get("registry")
+    flush_s = telemetry_flush_s(test)
+    try:
+        if tracer is not None:
+            tracer.attach_journal(make_path(test, TRACE_JOURNAL_FILE),
+                                  flush_s=flush_s)
+        if registry is not None:
+            registry.attach_journal(
+                make_path(test, METRICS_JOURNAL_FILE), flush_s=flush_s)
+    except Exception:  # noqa: BLE001
+        logger.warning("couldn't attach telemetry journals",
+                       exc_info=True)
+
+
+def write_obs(test, final=False):
     """Writes the observability artifacts next to results.json:
     ``trace.jsonl`` (Chrome-trace/Perfetto span stream) and
     ``metrics.json`` (the registry snapshot). The handles live under
     test["obs"] (set by obs.run_scope; nonserializable).
+
+    ``final=True`` (core.run's last write, after the root span closed)
+    additionally retires the incremental telemetry journals: the
+    atomic artifacts now strictly supersede them. The save_1/save_2
+    writes keep journaling — the run is still emitting events, and a
+    kill between save_1 and finalize must not lose them.
+
+    While an incremental journal is attached, the non-final calls skip
+    the full atomic dump: the journal on disk is strictly fresher than
+    any mid-run snapshot could be, and re-serializing the whole event
+    buffer at save_1/save_2 costs real wall clock on large traces. A
+    journal-less run (attach failed, or a caller never opened one)
+    keeps the old dump-at-every-save behavior as its only crash
+    insurance.
 
     Failures are logged, never raised: telemetry is a byproduct, and a
     disk-full trace dump inside save_1 must not abort the run before
@@ -278,10 +342,16 @@ def write_obs(test):
     registry = o.get("registry")
     try:
         if tracer is not None:
-            tracer.dump(make_path(test, "trace.jsonl"))
+            if final or not tracer.journaling():
+                tracer.dump(make_path(test, "trace.jsonl"))
+            if final:
+                tracer.close_journal(remove=True)
         if registry is not None:
-            _dump_json(registry.snapshot(),
-                       make_path(test, "metrics.json"))
+            if final or not registry.journaling():
+                _dump_json(registry.snapshot(),
+                           make_path(test, "metrics.json"))
+            if final:
+                registry.close_journal(remove=True)
     except Exception:  # noqa: BLE001
         logger.warning("couldn't write obs artifacts", exc_info=True)
 
@@ -383,6 +453,34 @@ def load_results(test_name, test_time):
     with open(path({"name": test_name, "start-time": test_time},
                    "results.json")) as f:
         return json.load(f)
+
+
+def load_run_trace(run_dir):
+    """A run directory's trace events: ``trace.jsonl``, falling back
+    to the incremental ``trace.jsonl.journal`` when only it survived
+    (a kill -9 before finalize — exactly the run whose trace matters).
+    Returns [] when neither exists."""
+    from .obs import load_trace
+    for name in ("trace.jsonl", TRACE_JOURNAL_FILE):
+        p = os.path.join(str(run_dir), name)
+        if os.path.exists(p):
+            return load_trace(p)
+    return []
+
+
+def load_run_metrics(run_dir):
+    """A run directory's metrics snapshot: ``metrics.json``, falling
+    back to the journal's last parseable snapshot line. None when
+    neither exists."""
+    p = os.path.join(str(run_dir), "metrics.json")
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+    from .obs import load_metrics_journal
+    return load_metrics_journal(
+        os.path.join(str(run_dir), METRICS_JOURNAL_FILE))
 
 
 _results_cache = {}

@@ -13,6 +13,7 @@ The ``/api/`` routes turn the viewer into checking-as-a-service
     POST /api/campaigns       sweep matrix -> campaign id (202)
     GET  /api/campaigns       submitted/stored campaign ids
     GET  /api/campaigns/<id>  pollable status + records
+    GET  /api/metrics         live Prometheus text exposition
 
 API transport hardening lives here: request bodies are refused (413)
 when Content-Length exceeds ``service.MAX_BODY_BYTES`` -- BEFORE any
@@ -94,13 +95,20 @@ def _fast_tests():
             except (FileNotFoundError, json.JSONDecodeError):
                 valid = "incomplete"
             fake = {"name": name, "start-time": t}
-            obs_files = [f for f in ("trace.jsonl", "metrics.json",
-                                     "analysis.json", "monitor.json")
+            obs_files = [f for f in ("metrics.json", "analysis.json",
+                                     "monitor.json")
                          if os.path.exists(store.path(fake, f))]
             mon = _monitor_header(store.path(fake, "monitor.json")) \
                 if "monitor.json" in obs_files else None
+            # the Trace column: the finalized trace, or the crash-safe
+            # journal a kill -9'd run left behind (exactly the run
+            # whose trace matters most)
+            trace = next(
+                (f for f in ("trace.jsonl", store.TRACE_JOURNAL_FILE)
+                 if os.path.exists(store.path(fake, f))), None)
             rows.append({"name": name, "time": t, "valid": valid,
-                         "obs": obs_files, "monitor": mon})
+                         "obs": obs_files, "monitor": mon,
+                         "trace": trace})
     rows.sort(key=lambda r: r["time"], reverse=True)
     return rows
 
@@ -114,12 +122,18 @@ def _home_page():
         obs_links = " ".join(
             f'<a href="{link}{f}">{html.escape(f.split(".")[0])}</a>'
             for f in t.get("obs", ()))
+        trace = t.get("trace")
+        trace_cell = "" if trace is None else (
+            f'<a href="{link}{trace}">'
+            f'{"journal" if trace.endswith(".journal") else "trace"}'
+            "</a>")
         rows.append(
             f'<tr class="{_valid_class(t["valid"])}">'
             f'<td>{html.escape(t["name"])}</td>'
             f'<td><a href="{link}">{html.escape(t["time"])}</a></td>'
             f'<td>{html.escape(str(t["valid"]))}</td>'
             f'<td>{_monitor_cell(t.get("monitor"))}</td>'
+            f'<td>{trace_cell}</td>'
             f'<td>{obs_links}</td>'
             f'<td><a href="{zip_link}">zip</a></td></tr>')
     return f"""<html><head><style>{STYLE}</style>
@@ -127,7 +141,7 @@ def _home_page():
 <h1>Jepsen</h1>
 <p><a href="/campaigns">Campaigns</a></p>
 <table><thead><tr><th>Test</th><th>Time</th><th>Valid?</th>
-<th>Monitor</th><th>Observability</th><th></th>
+<th>Monitor</th><th>Trace</th><th>Observability</th><th></th>
 </tr></thead><tbody>{''.join(rows)}</tbody></table></body></html>"""
 
 
@@ -150,9 +164,56 @@ def _campaign_cell_class(outcome):
     return "valid-unknown"
 
 
+def _flat_key(key):
+    """Parse a flattened metrics key ``name{k=v,...}`` back into
+    ``(name, {k: v})`` — the view-layer inverse of obs.metrics'
+    snapshot keys. Best effort: label VALUES containing ``=``/``,``
+    parse wrong, which costs one utilization-table cell, not data."""
+    if "{" not in key:
+        return key, {}
+    name, _, rest = key.partition("{")
+    labels = {}
+    for part in rest.rstrip("}").split(","):
+        k, _, v = part.partition("=")
+        labels[k] = v
+    return name, labels
+
+
+def _utilization_rows(cid, records):
+    """Per-worker utilization for one campaign: cells run / wall
+    seconds from the cell records, steal counts and sync failures from
+    the campaign's merged metrics (metrics.json, falling back to the
+    crash-safe journal while the campaign is still live)."""
+    per = {}
+
+    def row(w):
+        return per.setdefault(str(w), {"cells": 0, "wall_s": 0.0,
+                                       "steals": 0, "sync_failures": 0})
+
+    for r in records:
+        st = row(r.get("worker") or "local")
+        st["cells"] += 1
+        st["wall_s"] += float(r.get("wall_s") or 0.0)
+    metrics = store.load_run_metrics(store.campaign_path(cid)) or {}
+    for k, v in (metrics.get("counters") or {}).items():
+        name, labels = _flat_key(k)
+        w = labels.get("worker")
+        if not w:
+            continue
+        if name == "fleet.cells_stolen":
+            row(w)["steals"] += int(v)
+        elif name == "fleet.artifact_syncs" \
+                and labels.get("status") == "failed":
+            row(w)["sync_failures"] += int(v)
+    return per
+
+
 def _campaigns_page():
     """Campaign index: one section per campaign, its runs grouped by
-    cell (web's view of store/campaigns/<id>/)."""
+    cell (web's view of store/campaigns/<id>/). Fleet campaigns
+    additionally link the merged ``campaign_trace.jsonl`` (one
+    Perfetto timeline, one lane per worker, clocks normalized) and
+    render the per-worker utilization table."""
     sections = []
     for cid in sorted(store.campaigns(), reverse=True):
         data = store.load_campaign(cid)
@@ -183,10 +244,31 @@ def _campaigns_page():
                 f"</tr>")
         planned = len(meta.get("cells") or [])
         files = f"/files/{store.CAMPAIGNS_DIR}/{urllib.parse.quote(cid)}/"
+        trace_link = ""
+        if os.path.exists(store.campaign_path(cid,
+                                              "campaign_trace.jsonl")):
+            trace_link = (f' &mdash; <a href="{files}'
+                          'campaign_trace.jsonl">merged trace</a>')
+        util = _utilization_rows(cid, records)
+        util_table = ""
+        if util:
+            urows = "".join(
+                f"<tr><td>{html.escape(w)}</td>"
+                f"<td>{st['cells']}</td>"
+                f"<td>{st['wall_s']:.1f}</td>"
+                f"<td>{st['steals']}</td>"
+                f"<td>{st['sync_failures']}</td></tr>"
+                for w, st in sorted(util.items()))
+            util_table = (
+                "<table><thead><tr><th>Worker</th><th>Cells</th>"
+                "<th>Wall (s)</th><th>Steals</th>"
+                "<th>Sync failures</th></tr></thead>"
+                f"<tbody>{urows}</tbody></table>")
         sections.append(
             f'<h2><a href="{files}">{html.escape(cid)}</a></h2>'
             f"<p>status: {html.escape(str(meta.get('status')))} &mdash; "
-            f"{len(records)}/{planned} cells ({html.escape(badge)})</p>"
+            f"{len(records)}/{planned} cells ({html.escape(badge)})"
+            f"{trace_link}</p>{util_table}"
             f"<table><thead><tr><th>Cell</th><th>Outcome</th>"
             f"<th>Valid?</th><th>Run</th><th>Wall (s)</th></tr></thead>"
             f"<tbody>{''.join(rows)}</tbody></table>")
@@ -344,6 +426,16 @@ class Handler(BaseHTTPRequestHandler):
                 cid = clean[len("/api/campaigns/"):]
                 return self._send_json(200,
                                        service.campaign_status(cid))
+            if clean == "/api/metrics":
+                # live health surface: the bound obs registry, fleet
+                # dispatch gauges, admission state, and the compile
+                # ledger -- Prometheus text exposition, authenticated
+                # like every other route (the caller gate above)
+                if method != "GET":
+                    raise service.ApiError(405, "GET only")
+                return self._send(
+                    200, service.metrics_text(),
+                    "text/plain; version=0.0.4; charset=utf-8")
             raise service.ApiError(404, f"unknown API route {path!r}")
         except service.ApiError as e:
             return self._send_json(e.status, e.payload,

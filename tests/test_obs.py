@@ -85,13 +85,16 @@ def test_trace_dump_is_chrome_trace_loadable(tmp_path):
     text = pathlib.Path(p).read_text()
     assert text.startswith("[\n")
     # chrome://tracing's parser: complete the array and load it whole
+    # (trace_meta is the wall-clock/context anchor obs.merge keys on)
     whole = json.loads(text.rstrip().rstrip(",") + "]")
-    assert {e["name"] for e in whole} == {"phase", "marker", "frontier"}
+    assert {e["name"] for e in whole} == {"trace_meta", "phase",
+                                          "marker", "frontier"}
     for e in whole:
         assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
     # line-by-line (jq/grep style) via the tolerant loader
     evs = obs.load_trace(p)
-    assert len(evs) == 3
+    assert len(evs) == 4
+    assert obs.trace_meta(evs)["epoch_ns"] > 0
     x = [e for e in evs if e["ph"] == "X"][0]
     assert x["dur"] >= 0
 

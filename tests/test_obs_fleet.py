@@ -1,0 +1,640 @@
+"""Fleet telemetry-plane tests: crash-safe trace/metrics journals,
+Prometheus exposition, clock-skew normalization from the lease
+handshake, deterministic campaign trace merging, the search-heartbeat
+journal flush, /api/metrics over a real socket (401 without a token,
+exposition format with one), and the loopback fleet producing one
+merged campaign_trace.jsonl with per-worker lanes."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import obs, store, web
+from jepsen_tpu.analysis import planlint
+from jepsen_tpu.campaign import compile_cache, plan
+from jepsen_tpu.campaign.journal import CampaignJournal
+from jepsen_tpu.fleet import dispatch, ledger as fledger, service
+from jepsen_tpu.obs import merge as obs_merge
+from jepsen_tpu.obs import search as obs_search
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+    compile_cache.reset()
+    service.reset()
+    yield
+    compile_cache.reset()
+    service.reset()
+
+
+# ---------------------------------------------------------------------------
+# crash-safe journals: tracer
+
+
+def test_tracer_journal_mirrors_events_and_survives_torn_tail(tmp_path):
+    p = str(tmp_path / "trace.jsonl.journal")
+    tr = obs.Tracer(context={"campaign": "c1", "cell": "a"})
+    with tr.span("early"):
+        pass
+    tr.attach_journal(p, flush_s=0.0)   # backfills the buffered span
+    with tr.span("late"):
+        pass
+    tr.flush_journal()
+    # a kill -9 mid-append leaves a torn final line
+    with open(p, "a") as f:
+        f.write('{"name": "torn')
+    evs = obs.load_trace(p)
+    names = [e["name"] for e in evs]
+    assert names[0] == "trace_meta"     # wall anchor heads the journal
+    assert "early" in names and "late" in names
+    assert "torn" not in " ".join(names)
+    meta = obs.trace_meta(evs)
+    assert meta["epoch_ns"] > 0
+    assert meta["context"] == {"campaign": "c1", "cell": "a"}
+
+
+def test_tracer_close_journal_remove_retires_the_file(tmp_path):
+    p = str(tmp_path / "t.journal")
+    tr = obs.Tracer()
+    tr.attach_journal(p)
+    assert os.path.exists(p)
+    tr.close_journal(remove=True)
+    assert not os.path.exists(p)
+    # and emitting afterwards neither fails nor resurrects it
+    tr.instant("after")
+    assert not os.path.exists(p)
+
+
+# ---------------------------------------------------------------------------
+# crash-safe journals: registry
+
+
+def test_registry_journal_last_snapshot_wins_torn_tail(tmp_path):
+    p = str(tmp_path / "metrics.json.journal")
+    reg = obs.Registry(default_labels={"worker": "w1"})
+    reg.attach_journal(p, flush_s=0.0)
+    reg.inc("fleet.cells", outcome="True")
+    reg.journal_now()
+    reg.inc("fleet.cells", outcome="True")
+    reg.journal_now()
+    with open(p, "a") as f:
+        f.write('{"counters": {"torn')
+    snap = obs.load_metrics_journal(p)
+    # the last PARSEABLE snapshot line, with default labels merged in
+    assert snap["counters"][
+        "fleet.cells{outcome=True,worker=w1}"] == 2
+    assert obs.load_metrics_journal(str(tmp_path / "nope")) is None
+
+
+def test_registry_default_labels_stamp_every_series():
+    reg = obs.Registry(default_labels={"campaign": "c", "cell": "x"})
+    reg.inc("ops")
+    reg.set_gauge("depth", 3, phase="search")
+    reg.observe("lat", 0.5)
+    snap = reg.snapshot()
+    assert snap["counters"] == {"ops{campaign=c,cell=x}": 1}
+    assert snap["gauges"] == {"depth{campaign=c,cell=x,phase=search}": 3}
+    assert list(snap["histograms"]) == ["lat{campaign=c,cell=x}"]
+
+
+def test_run_dir_loaders_fall_back_to_journals(tmp_path):
+    d = str(tmp_path / "run")
+    os.makedirs(d)
+    tr = obs.Tracer()
+    tr.attach_journal(os.path.join(d, store.TRACE_JOURNAL_FILE),
+                      flush_s=0.0)
+    tr.instant("only-in-journal")
+    tr.flush_journal()
+    reg = obs.Registry()
+    reg.attach_journal(os.path.join(d, store.METRICS_JOURNAL_FILE),
+                       flush_s=0.0)
+    reg.inc("n")
+    reg.journal_now()
+    # no trace.jsonl / metrics.json were ever finalized (kill -9)
+    evs = store.load_run_trace(d)
+    assert any(e["name"] == "only-in-journal" for e in evs)
+    assert store.load_run_metrics(d)["counters"]["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# search heartbeats flush the journals (the satellite bugfix)
+
+
+def test_search_heartbeat_forces_journal_to_disk(tmp_path):
+    tp = str(tmp_path / "t.journal")
+    mp = str(tmp_path / "m.journal")
+    tr, reg = obs.Tracer(), obs.Registry()
+    # an interval so long only an explicit flush can land anything
+    tr.attach_journal(tp, flush_s=9999)
+    reg.attach_journal(mp, flush_s=9999)
+    with obs.bind(tr, reg):
+        so = obs_search.capture()
+        so.heartbeat("jax-wgl", iteration=3, chunk_s=0.2, frontier=17,
+                     explored=1000)
+    evs = obs.load_trace(tp)
+    hb = [e for e in evs if e["name"] == "wgl.heartbeat.jax-wgl"]
+    assert hb and hb[-1]["args"]["explored"] == 1000
+    snap = obs.load_metrics_journal(mp)
+    assert snap["gauges"]["wgl.states_explored{engine=jax-wgl}"] == 1000
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+
+
+def test_render_prometheus_families_and_determinism():
+    reg = obs.Registry()
+    reg.inc("fleet.cells", 2, outcome="True")
+    reg.set_gauge("fleet.lease_active", 1)
+    reg.set_gauge("store.path", "/tmp/x")      # non-numeric: skipped
+    reg.observe("fleet.cell_s", 0.05)
+    text = obs.render_prometheus([reg])
+    assert '# TYPE jepsen_fleet_cells counter' in text
+    assert 'jepsen_fleet_cells{outcome="True"} 2' in text
+    assert '# TYPE jepsen_fleet_lease_active gauge' in text
+    assert "jepsen_fleet_lease_active 1" in text
+    assert "store_path" not in text
+    assert '# TYPE jepsen_fleet_cell_s histogram' in text
+    assert 'jepsen_fleet_cell_s_bucket{le="+Inf"} 1' in text
+    assert "jepsen_fleet_cell_s_count 1" in text
+    # deterministic: same inputs, byte-identical body
+    assert obs.render_prometheus([reg]) == text
+    # structured sections (the fleet dispatcher's live gauges) render
+    # alongside registries
+    text2 = obs.render_prometheus(
+        [reg, {"gauges": {"fleet.pending_cells": 4}}])
+    assert "jepsen_fleet_pending_cells 4" in text2
+
+
+def test_metrics_text_includes_admission_and_sources():
+    service.register_metrics_source(
+        "t", lambda: {"gauges": {"fleet.lease_active": 2}})
+    led = fledger.attach()
+    led.note_stats(5, 2)
+    try:
+        text = service.metrics_text()
+    finally:
+        service.unregister_metrics_source("t")
+        fledger.detach(expected=led)
+    assert "jepsen_admission_queue_depth 0" in text
+    assert "jepsen_admission_shed_total 0" in text
+    assert "jepsen_fleet_lease_active 2" in text
+    assert "jepsen_ledger_hits 5" in text
+    assert "jepsen_ledger_misses 2" in text
+
+
+# ---------------------------------------------------------------------------
+# clock-skew normalization
+
+
+def test_clock_offset_uses_the_tight_return_leg():
+    # worker clock 2 s AHEAD, 50 ms return leg: the estimate is the
+    # offset minus only that return latency
+    clock = {"coord-sent-epoch": 100.0,
+             "worker-received-epoch": 102.05,
+             "worker-result-epoch": 103.0,
+             "coord-received-epoch": 101.05}
+    assert obs_merge.clock_offset(clock) == pytest.approx(1.95)
+    assert obs_merge.clock_offset({"coord-sent-epoch": 1.0}) is None
+    assert obs_merge.clock_offset(None) is None
+
+
+def test_clock_offset_immune_to_forward_leg_boot_delay():
+    # a loopback worker (true offset 0) whose spawn took 6 s: the
+    # symmetric midpoint would report +3 s; the return leg stays
+    # within its own ~10 ms latency
+    clock = {"coord-sent-epoch": 100.0,
+             "worker-received-epoch": 106.0,   # interpreter boot
+             "worker-result-epoch": 110.0,
+             "coord-received-epoch": 110.01}
+    assert abs(obs_merge.clock_offset(clock)) < 0.05
+
+
+def test_worker_offsets_take_the_median_per_worker():
+    def rec(w, off):
+        return {"worker": w,
+                "clock": {"coord-sent-epoch": 0.0,
+                          "worker-received-epoch": off,
+                          "worker-result-epoch": 10.0 + off,
+                          "coord-received-epoch": 10.0}}
+    offs = obs_merge.worker_offsets(
+        [rec("w1", 1.0), rec("w1", 1.2), rec("w1", 40.0),
+         rec("w2", -3.0), {"worker": "w3"}])
+    assert offs["w1"] == pytest.approx(1.2)   # median damps the outlier
+    assert offs["w2"] == pytest.approx(-3.0)
+    assert "w3" not in offs
+
+
+# ---------------------------------------------------------------------------
+# campaign trace merge
+
+
+COORD_EPOCH_NS = 1_000_000_000_000_000_000
+
+
+def _write_trace(d, epoch_ns, events, context=None):
+    os.makedirs(d, exist_ok=True)
+    meta = {"name": "trace_meta", "ph": "i", "cat": "__metadata",
+            "ts": 0, "pid": 1, "tid": 0, "s": "g",
+            "args": {"epoch_ns": epoch_ns,
+                     **({"context": context} if context else {})}}
+    with open(os.path.join(d, "trace.jsonl"), "w") as f:
+        for ev in [meta] + events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def _mk_campaign(cid, worker_offset_s=2.0, run_start_s=1.0):
+    """A synthetic fleet campaign: a coordinator trace plus one worker
+    run whose wall clock is ``worker_offset_s`` ahead and whose run
+    began ``run_start_s`` after the coordinator's trace origin."""
+    jr = CampaignJournal(cid)
+    jr.write_meta({"id": cid, "status": "complete", "cells": ["c0"]})
+    _write_trace(store.campaign_path(cid), COORD_EPOCH_NS,
+                 [{"name": "fleet.lease.grant", "ph": "i", "ts": 500.0,
+                   "pid": 9, "tid": 1, "cat": "fleet"}])
+    run_dir = store.campaign_path(cid, "run-c0")
+    _write_trace(run_dir,
+                 COORD_EPOCH_NS
+                 + int((run_start_s + worker_offset_s) * 1e9),
+                 [{"name": "jepsen.run", "ph": "X", "ts": 0.0,
+                   "dur": 2e6, "pid": 4, "tid": 1, "cat": "lifecycle"}],
+                 context={"campaign": cid, "cell": "c0",
+                          "worker": "w1"})
+    jr.append_cell({"cell": "c0", "group": "g", "outcome": True,
+                    "worker": "w1", "path": run_dir, "wall_s": 2.0,
+                    "clock": {"coord-sent-epoch": 100.0,
+                              "worker-received-epoch":
+                                  100.05 + worker_offset_s,
+                              "worker-result-epoch":
+                                  103.0 + worker_offset_s,
+                              "coord-received-epoch": 103.05}})
+    return jr
+
+
+def test_merge_normalizes_worker_clock_onto_coordinator():
+    _mk_campaign("skew", worker_offset_s=2.0, run_start_s=1.0)
+    info = obs_merge.merge_campaign("skew")
+    # return-leg estimate: the true 2 s offset minus the 50 ms result
+    # latency the synthetic handshake encodes
+    assert info["workers"]["w1"]["offset_s"] == pytest.approx(1.95)
+    evs = obs.load_trace(info["path"])
+    run = [e for e in evs if e["name"] == "jepsen.run"][0]
+    # worker ts=0 lands ~1.0 s after the coordinator's origin: the
+    # 2 s wall-clock lie is corrected out (to within the return-leg
+    # latency)
+    assert run["ts"] == pytest.approx(1.05e6)
+    # one process lane per worker, coordinator first
+    lanes = {(e.get("args") or {}).get("name"): e["pid"]
+             for e in evs if e.get("name") == "process_name"}
+    assert lanes["coordinator"] == 1
+    assert lanes["worker w1"] == 2
+    assert run["pid"] == 2
+    grant = [e for e in evs if e["name"] == "fleet.lease.grant"][0]
+    assert grant["pid"] == 1
+
+
+def test_merge_is_deterministic_and_counts_skips():
+    jr = _mk_campaign("det")
+    # a cell whose artifacts were never mirrored home is skipped
+    jr.append_cell({"cell": "c1", "group": "g", "outcome": "crashed",
+                    "worker": "w2",
+                    "path": store.campaign_path("det", "never-synced")})
+    info1 = obs_merge.merge_campaign("det")
+    assert info1["skipped"] == 1 and info1["cells"] == 1
+    with open(info1["path"], "rb") as f:
+        body1 = f.read()
+    info2 = obs_merge.merge_campaign("det")
+    with open(info2["path"], "rb") as f:
+        assert f.read() == body1    # byte-identical re-merge
+    assert obs.load_trace(info1["path"])    # and Perfetto-loadable
+
+
+def test_merge_falls_back_to_trace_journal():
+    jr = _mk_campaign("jfall")
+    run_dir = store.campaign_path("jfall", "run-killed")
+    os.makedirs(run_dir)
+    # only the incremental journal survived the kill -9, torn tail
+    with open(os.path.join(run_dir, store.TRACE_JOURNAL_FILE),
+              "w") as f:
+        f.write(json.dumps(
+            {"name": "trace_meta", "ph": "i", "cat": "__metadata",
+             "ts": 0, "pid": 1, "tid": 0,
+             "args": {"epoch_ns": COORD_EPOCH_NS}}) + "\n")
+        f.write(json.dumps(
+            {"name": "op", "ph": "i", "ts": 7.0, "pid": 1,
+             "tid": 1}) + "\n")
+        f.write('{"name": "torn')
+    jr.append_cell({"cell": "c9", "group": "g", "outcome": "crashed",
+                    "worker": "w9", "path": run_dir})
+    info = obs_merge.merge_campaign("jfall")
+    evs = obs.load_trace(info["path"])
+    assert any(e["name"] == "op" for e in evs)
+
+
+def test_merge_unknown_campaign_raises():
+    with pytest.raises(FileNotFoundError):
+        obs_merge.merge_campaign("no-such-campaign")
+
+
+# a worker whose wall clock lies by SKEW_S seconds: every worker-side
+# epoch leaving the host — the result line's handshake stamps AND the
+# synced trace anchors — is shifted, exactly like a host with a wrong
+# clock. The trace-anchor rewrite is digit-count-preserving so the
+# sync plane's manifest size verification still passes.
+SKEW_S = -30.0
+
+
+def _shift_trace_epochs(path, skew_s):
+    with open(path) as f:
+        body = f.read()
+    import re
+
+    def shift(m):
+        return f'"epoch_ns"{m.group(1)}{int(m.group(2)) + int(skew_s * 1e9)}'
+
+    with open(path, "w") as f:
+        f.write(re.sub(r'"epoch_ns"(:\s*)(\d+)', shift, body))
+
+
+class _SkewConn:
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def execute(self, ctx, action):
+        from jepsen_tpu.fleet.worker import RESULT_MARKER
+        res = self._inner.execute(ctx, action)
+        out = res.get("out")
+        if isinstance(out, str) and RESULT_MARKER in out:
+            lines = []
+            for ln in out.splitlines():
+                if ln.startswith(RESULT_MARKER):
+                    rec = json.loads(ln[len(RESULT_MARKER):])
+                    ck = rec.get("clock") or {}
+                    for k in ("worker-received-epoch",
+                              "worker-result-epoch"):
+                        if k in ck:
+                            ck[k] += SKEW_S
+                    ln = RESULT_MARKER + json.dumps(rec)
+                lines.append(ln)
+            res = dict(res)
+            res["out"] = "\n".join(lines)
+        return res
+
+    def download(self, ctx, remote_paths, local_path):
+        res = self._inner.download(ctx, remote_paths, local_path)
+        for root, _dirs, files in os.walk(str(local_path)):
+            for f in files:
+                if f in ("trace.jsonl", store.TRACE_JOURNAL_FILE):
+                    _shift_trace_epochs(os.path.join(root, f), SKEW_S)
+        return res
+
+
+@pytest.mark.slow
+def test_merge_corrects_a_deliberately_offset_worker(tmp_path,
+                                                     monkeypatch):
+    real_connect = dispatch.Worker.connect
+    monkeypatch.setattr(dispatch.Worker, "connect",
+                        lambda self: _SkewConn(real_connect(self)))
+    rep = dispatch.run_fleet(
+        _noop_cells(1), dispatch.parse_workers("local"),
+        campaign_id="skewed", base_options=NOOP_OPTS, lease_s=120,
+        sync_timeout_s=60, worker_store_dir=str(tmp_path / "wstore"),
+        builder="jepsen_tpu.demo:demo_test")
+    assert rep["status"] == "complete"
+    # the handshake saw through the lie (to within the return-leg
+    # latency of a loaded box)
+    w = rep["trace"]["workers"]["local"]
+    assert w["offset_s"] == pytest.approx(SKEW_S, abs=5.0)
+    # causality in the merged timeline: the worker's run span cannot
+    # start before the coordinator granted its lease. Uncorrected, a
+    # -30 s worker clock would place the run HALF A MINUTE before the
+    # grant; normalized, it follows it.
+    evs = obs.load_trace(rep["trace"]["path"])
+    grant_ts = min(e["ts"] for e in evs
+                   if e.get("name") == "fleet.lease.grant")
+    run_ts = min(e["ts"] for e in evs
+                 if e.get("name") == "jepsen.run"
+                 and e.get("ph") == "X")
+    assert run_ts > grant_ts
+
+
+# ---------------------------------------------------------------------------
+# planlint PL017
+
+
+def test_pl017_rules():
+    diags = planlint.lint_telemetry({"telemetry-flush-ms": 0})
+    assert [d.code for d in diags] == ["PL017"]
+    assert diags[0].severity == "error"
+    assert not planlint.lint_telemetry({"telemetry-flush-ms": 250})
+    # exposed /api/metrics without a token
+    diags = planlint.lint_telemetry(
+        {"metrics?": True, "serve-ip": "0.0.0.0"})
+    assert any(d.code == "PL017" and d.severity == "error"
+               for d in diags)
+    assert not planlint.lint_telemetry(
+        {"metrics?": True, "serve-ip": "127.0.0.1"})
+    assert not planlint.lint_telemetry(
+        {"metrics?": True, "serve-ip": "0.0.0.0", "auth-token?": True})
+    # merge with artifact sync explicitly off: warning
+    diags = planlint.lint_telemetry(
+        {"trace-merge?": True, "sync?": False})
+    assert [d.severity for d in diags] == ["warning"]
+    assert not planlint.lint_telemetry(
+        {"trace-merge?": True, "sync?": None})
+    # and the per-test preflight path flags the flush knob
+    diags = planlint.lint_plan({"client": None, "generator": None,
+                                "telemetry-flush-ms": -5})
+    assert any(d.code == "PL017" for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# kill -9 mid-run leaves parseable journaled telemetry
+
+CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+from jepsen_tpu import core, demo, store
+store.base_dir = {base!r}
+options = {{"nodes": ["n1"], "concurrency": 1, "ssh": {{"dummy?": True}},
+           "time-limit": 60, "workload": "register"}}
+test = demo.demo_test(options)
+test["telemetry-flush-ms"] = 50
+core.run(core.prepare_test(test))
+"""
+
+
+@pytest.mark.slow
+def test_kill9_mid_run_leaves_parseable_journals(tmp_path):
+    base = str(tmp_path / "store")
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         CHILD.format(repo=REPO, base=base)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        # wait for the run's trace journal to appear and accumulate
+        journal = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and journal is None:
+            for root, _dirs, files in os.walk(base):
+                if store.TRACE_JOURNAL_FILE in files:
+                    journal = os.path.join(root,
+                                           store.TRACE_JOURNAL_FILE)
+                    break
+            time.sleep(0.1)
+        assert journal, "run never opened its telemetry journal"
+        # let some mid-run events land, then kill -9
+        while time.monotonic() < deadline \
+                and os.path.getsize(journal) < 4096:
+            time.sleep(0.1)
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    run_dir = os.path.dirname(journal)
+    # the journals parse despite the kill: the whole point of the
+    # discipline (the run's save_1 checkpoint may have dumped a
+    # trace.jsonl already, but only the journal kept appending)
+    evs = obs.load_trace(journal)
+    assert any(e.get("name") == "trace_meta" for e in evs)
+    assert any(e.get("cat") == "op" for e in evs)
+    # the journal mirrors every buffered event, so it is never BEHIND
+    # whatever checkpoint dump happens to exist
+    dump = os.path.join(run_dir, "trace.jsonl")
+    if os.path.exists(dump):
+        assert len(evs) >= len(obs.load_trace(dump))
+    metrics = obs.load_metrics_journal(
+        os.path.join(run_dir, store.METRICS_JOURNAL_FILE))
+    assert metrics is not None and metrics.get("counters")
+
+
+# ---------------------------------------------------------------------------
+# /api/metrics over a real socket
+
+
+@pytest.fixture
+def token_server():
+    server = web.serve({"ip": "127.0.0.1", "port": 0,
+                        "token": "sekrit"})
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def _get(base, path, token=None):
+    req = urllib.request.Request(base + path)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read().decode(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), dict(e.headers)
+
+
+def test_api_metrics_401_without_token(token_server):
+    status, body, _ = _get(token_server, "/api/metrics")
+    assert status == 401
+    assert "error" in json.loads(body)
+    status, _, _ = _get(token_server, "/api/metrics", token="wrong")
+    assert status == 401
+
+
+def test_api_metrics_exposition_with_token(token_server):
+    service.register_metrics_source(
+        "fleet:test", lambda: {"gauges": {"fleet.lease_active": 3,
+                                          "fleet.pending_cells": 1}})
+    led = fledger.attach()
+    led.note_stats(4, 1)
+    try:
+        status, body, headers = _get(token_server, "/api/metrics",
+                                     token="sekrit")
+    finally:
+        service.unregister_metrics_source("fleet:test")
+        fledger.detach(expected=led)
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    assert "# TYPE jepsen_fleet_lease_active gauge" in body
+    assert "jepsen_fleet_lease_active 3" in body
+    assert "jepsen_admission_queue_depth 0" in body
+    assert "jepsen_admission_shed_total 0" in body
+    assert "jepsen_ledger_hits 4" in body
+    # POST is not a scrape
+    req = urllib.request.Request(
+        token_server + "/api/metrics?token=sekrit", data=b"{}",
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 405
+
+
+# ---------------------------------------------------------------------------
+# the loopback fleet end to end
+
+NOOP_OPTS = {"nodes": ["n1"], "concurrency": 1, "ssh": {"dummy?": True},
+             "time-limit": 1, "workload": "noop"}
+
+
+def _noop_cells(n=2):
+    return plan.expand({"axes": {"seed": list(range(n)),
+                                 "workload": ["noop"]}})
+
+
+@pytest.mark.slow
+def test_fleet_campaign_produces_merged_trace(tmp_path):
+    marker = str(tmp_path / "die-once")
+    cells = _noop_cells(2)
+    cells[0]["params"]["die-once-marker"] = marker   # one real kill -9
+    rep = dispatch.run_fleet(
+        cells, dispatch.parse_workers("local,local"),
+        campaign_id="obsfleet", base_options=NOOP_OPTS, lease_s=120,
+        builder="jepsen_tpu.demo:demo_test")
+    assert rep["status"] == "complete"
+    assert rep["trace"]["events"] > 0
+    p = store.campaign_path("obsfleet", "campaign_trace.jsonl")
+    assert os.path.exists(p)
+    evs = obs.load_trace(p)
+    lanes = {(e.get("args") or {}).get("name")
+             for e in evs if e.get("name") == "process_name"}
+    assert "coordinator" in lanes
+    assert any(str(n).startswith("worker ") for n in lanes)
+    # lease grants and the steal are first-class trace events now
+    assert any(e.get("name") == "fleet.lease.grant" for e in evs)
+    assert any(e.get("name") == "fleet.lease.steal" for e in evs)
+    # worker-run spans merged in with their cell context intact
+    runs = [e for e in evs if e.get("name") == "jepsen.run"
+            and e.get("ph") == "X"]
+    assert runs and all(e["pid"] != 1 for e in runs)
+    # deterministic re-merge
+    with open(p, "rb") as f:
+        body = f.read()
+    obs_merge.merge_campaign("obsfleet")
+    with open(p, "rb") as f:
+        assert f.read() == body
+    # the campaign summary tool reads it
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "trace_summary.py"),
+         "--campaign", store.campaign_path("obsfleet")],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "coordinator" in out.stdout
+    assert "makespan" in out.stdout
+    # the web campaign page links the merged trace + utilization
+    page = web._campaigns_page()
+    assert "campaign_trace.jsonl" in page
+    assert "Sync failures" in page

@@ -14,9 +14,21 @@ next to results.json) and prints:
 Usage::
 
     python tools/trace_summary.py [STORE_DIR]
+    python tools/trace_summary.py --campaign [CAMPAIGN_DIR_OR_ID]
 
 STORE_DIR defaults to ``store/latest``. Accepts either a run directory
 (containing trace.jsonl) or anything with those two files in it.
+
+``--campaign`` reads a campaign directory's merged
+``campaign_trace.jsonl`` (one Perfetto timeline, one process lane per
+worker, clocks skew-normalized — written by the fleet dispatcher via
+``jepsen_tpu.obs.merge``) plus ``metrics.json``/``report.json`` and
+prints the campaign view: per-worker lanes with their clock offsets,
+makespan vs summed cell wall (achieved parallelism), per-worker
+utilization and exec/search/sync breakdown, device-slot wait,
+fleet lease/steal/sync/chaos counters, and the critical-path cells.
+The argument may be a campaign directory or a campaign id (resolved
+under ``store/campaigns/``); default: the most recent campaign.
 """
 
 from __future__ import annotations
@@ -81,10 +93,19 @@ def summarize(store_dir):
         with open(metrics_path) as f:
             metrics = json.load(f)
 
+    def _series(section, name):
+        """The first series matching ``name`` exactly or with labels
+        appended (``name{...}``) — campaign/fleet runs stamp their
+        obs-context as default labels into every snapshot key."""
+        for k, v in sorted((section or {}).items()):
+            if k == name or k.startswith(name + "{"):
+                return v
+        return None
+
     if metrics:
         if not op_durs_us:
-            h = metrics.get("histograms", {}) \
-                .get("interpreter.op_latency_s")
+            h = _series(metrics.get("histograms"),
+                        "interpreter.op_latency_s")
             if h and h.get("count"):
                 lines.append(f"\n-- op latency ({h['count']} ops, "
                              "from metrics histogram) --")
@@ -113,7 +134,7 @@ def summarize(store_dir):
         mon.update({k: v for k, v in
                     sorted(metrics.get("gauges", {}).items())
                     if k.startswith("monitor.")})
-        mh = metrics.get("histograms", {}).get("monitor.check_s")
+        mh = _series(metrics.get("histograms"), "monitor.check_s")
         if mon or mh:
             lines.append("\n-- streaming monitor --")
             for k, v in mon.items():
@@ -141,8 +162,162 @@ def summarize(store_dir):
     return "\n".join(lines)
 
 
+def _resolve_campaign_dir(arg):
+    """A campaign directory from a path, a campaign id, or (None) the
+    most recent campaign under store/campaigns/."""
+    if arg and os.path.isdir(arg):
+        return os.path.realpath(arg)
+    base = os.path.join("store", "campaigns")
+    if arg:
+        p = os.path.join(base, arg)
+        return os.path.realpath(p) if os.path.isdir(p) else None
+    if not os.path.isdir(base):
+        return None
+    cands = sorted(e for e in os.listdir(base)
+                   if os.path.isdir(os.path.join(base, e)))
+    return os.path.realpath(os.path.join(base, cands[-1])) \
+        if cands else None
+
+
+def _span_sum(events, pred):
+    return sum(e.get("dur", 0.0) for e in events
+               if e.get("ph") == "X" and pred(e))
+
+
+def summarize_campaign(campaign_dir):
+    """Render the campaign view of a merged trace; returns the text."""
+    lines = [f"== campaign {campaign_dir} =="]
+    trace_path = os.path.join(campaign_dir, "campaign_trace.jsonl")
+    if not os.path.exists(trace_path):
+        lines.append("(no campaign_trace.jsonl — run the fleet with "
+                     "trace merge enabled, or merge with "
+                     "jepsen_tpu.obs.merge.merge_campaign)")
+        return "\n".join(lines)
+    events = _load_trace(trace_path)
+
+    report = {}
+    try:
+        with open(os.path.join(campaign_dir, "report.json")) as f:
+            report = json.load(f)
+    except (OSError, ValueError):
+        pass
+    metrics = {}
+    try:
+        with open(os.path.join(campaign_dir, "metrics.json")) as f:
+            metrics = json.load(f)
+    except (OSError, ValueError):
+        pass
+
+    # -- lanes ----------------------------------------------------------
+    lanes = {int(e["pid"]): (e.get("args") or {}).get("name", "?")
+             for e in events
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    winfo = (report.get("trace") or {}).get("workers") or {}
+    lines.append(f"\n-- lanes ({len(events)} events) --")
+    for pid in sorted(lanes):
+        name = lanes[pid]
+        extra = ""
+        w = name[len("worker "):] if name.startswith("worker ") else None
+        if w in winfo:
+            extra = (f"   cells {winfo[w].get('cells')}, clock offset "
+                     f"{winfo[w].get('offset_s', 0.0):+.6f}s")
+        lines.append(f"lane {pid}: {name}{extra}")
+
+    # -- makespan vs summed cell wall -----------------------------------
+    xs = [e for e in events if e.get("ph") == "X"]
+    if xs:
+        t_lo = min(e.get("ts", 0.0) for e in xs)
+        t_hi = max(e.get("ts", 0.0) + e.get("dur", 0.0) for e in xs)
+        makespan_us = t_hi - t_lo
+        # the coordinator's fleet.cell spans cover lease exec end to
+        # end; runs merged from worker lanes carry jepsen.run
+        cell_spans = [e for e in xs if e.get("name") == "fleet.cell"] \
+            or [e for e in xs if e.get("name") == "jepsen.run"]
+        cell_sum_us = sum(e.get("dur", 0.0) for e in cell_spans)
+        lines.append("\n-- makespan --")
+        lines.append(f"{_fmt_s(makespan_us)}  campaign makespan")
+        lines.append(f"{_fmt_s(cell_sum_us)}  summed cell wall "
+                     f"({len(cell_spans)} cells)")
+        if makespan_us > 0 and cell_sum_us > 0:
+            lines.append(f"{cell_sum_us / makespan_us:10.2f}x "
+                         " achieved parallelism")
+
+        # -- per-worker utilization + breakdown -------------------------
+        lines.append("\n-- per-worker (exec / search / sync) --")
+        for pid in sorted(lanes):
+            name = lanes[pid]
+            lane_evs = [e for e in xs if e.get("pid") == pid]
+            if name == "coordinator":
+                # the coordinator's view of each worker, keyed by the
+                # span's worker arg: exec occupancy + sync wall
+                by_worker = {}
+                for e in lane_evs:
+                    w = (e.get("args") or {}).get("worker")
+                    if w is None:
+                        continue
+                    st = by_worker.setdefault(str(w),
+                                              {"exec": 0.0, "sync": 0.0})
+                    if e.get("name") == "fleet.cell":
+                        st["exec"] += e.get("dur", 0.0)
+                    elif e.get("name") == "fleet.artifact_sync":
+                        st["sync"] += e.get("dur", 0.0)
+                for w, st in sorted(by_worker.items()):
+                    busy = st["exec"] / makespan_us * 100 \
+                        if makespan_us else 0.0
+                    lines.append(
+                        f"{w:>16}  exec {st['exec'] / 1e6:8.3f}s "
+                        f"({busy:5.1f}% of makespan)   sync "
+                        f"{st['sync'] / 1e6:8.3f}s")
+            else:
+                run_us = _span_sum(lane_evs,
+                                   lambda e: e.get("name") == "jepsen.run")
+                search_us = _span_sum(lane_evs,
+                                      lambda e: e.get("name") == "analyze")
+                if run_us or search_us:
+                    lines.append(
+                        f"{name:>16}  run {run_us / 1e6:8.3f}s   "
+                        f"search/analyze {search_us / 1e6:8.3f}s")
+
+        # -- critical path: the longest cells ---------------------------
+        longest = sorted(cell_spans, key=lambda e: -e.get("dur", 0.0))
+        if longest:
+            lines.append("\n-- critical path (longest cells) --")
+            for e in longest[:5]:
+                args = e.get("args") or {}
+                lines.append(
+                    f"{_fmt_s(e.get('dur', 0.0))}  "
+                    f"{args.get('cell', e.get('name'))} "
+                    f"(worker {args.get('worker', '?')})")
+
+    # -- device-slot wait -----------------------------------------------
+    dw = (metrics.get("histograms") or {}).get("campaign.device_wait_s")
+    if dw and dw.get("count"):
+        lines.append("\n-- device-slot wait --")
+        lines.append(f"mean {dw['sum'] / dw['count'] * 1e3:10.3f} ms   "
+                     f"max {dw['max'] * 1e3:10.3f} ms over "
+                     f"{dw['count']} check(s)")
+
+    # -- fleet counters (leases, steals, syncs, chaos) ------------------
+    counters = metrics.get("counters") or {}
+    fleet = {k: v for k, v in sorted(counters.items())
+             if k.startswith(("fleet.", "chaos."))}
+    if fleet:
+        lines.append("\n-- fleet counters --")
+        for k, v in fleet.items():
+            lines.append(f"{v!s:>12}  {k}")
+
+    return "\n".join(lines)
+
+
 def main(argv=None):
-    argv = argv if argv is not None else sys.argv[1:]
+    argv = list(argv if argv is not None else sys.argv[1:])
+    if argv and argv[0] == "--campaign":
+        cdir = _resolve_campaign_dir(argv[1] if len(argv) > 1 else None)
+        if cdir is None:
+            print("no campaign directory found", file=sys.stderr)
+            return 1
+        print(summarize_campaign(cdir))
+        return 0
     store_dir = argv[0] if argv else os.path.join("store", "latest")
     store_dir = os.path.realpath(store_dir)
     if not os.path.isdir(store_dir):

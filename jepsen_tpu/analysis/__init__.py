@@ -23,12 +23,20 @@ and two renderers (`render_text` / `to_json`):
   streaming monitor, and the fleet check service.
 * **codelint** -- AST thread-safety lint over the framework's own
   source, driven by ``tools/lint.py``.
+* **fleetlint** -- the control plane's own Jepsen: a post-hoc audit
+  of a campaign's recorded artifacts (``cells.jsonl`` journal, lease
+  events, per-run traces, sync manifests) against the fleet
+  protocol's invariants -- terminal-guard, single journal writer,
+  lease lifecycle, sync consistency, trace causality, chaos
+  accounting. Runs at fleet finalize and as the ``--resume``
+  preflight; report persists to
+  ``store/campaigns/<id>/fleet_analysis.json``.
 
 See doc/analysis.md for the code catalogue.
 """
 
-from . import (codelint, histlint, jaxlint, planlint,  # noqa: F401
-               searchplan)
+from . import (codelint, fleetlint, fleetmodel,  # noqa: F401
+               histlint, jaxlint, planlint, searchplan)
 from .diagnostics import (Diagnostic, ERROR, INFO,  # noqa: F401
                           SEVERITIES, WARNING, diag, errors,
                           max_severity, render_text, run_analyzer,
@@ -42,6 +50,7 @@ __all__ = [
     "errors", "warnings", "max_severity", "severity_counts",
     "render_text", "to_json", "run_analyzer",
     "histlint", "planlint", "jaxlint", "codelint", "searchplan",
+    "fleetlint", "fleetmodel",
     "lint_history", "lint_encoded", "lint_test_history",
     "lint_plan", "preflight", "PlanLintError",
 ]

@@ -28,6 +28,15 @@ Codes:
   CL001  unsynchronized mutation of a module-level mutable global
   CL002  unsynchronized class-attribute write
   CL003  unsynchronized ``global`` rebind
+  CL004  campaign-journal write (``append_cell`` / ``append_event``)
+         outside the coordinator role -- the journal's single-writer
+         invariant (the fleetlint FL004 oracle) enforced at the
+         source level: only the designated coordinator modules
+         (``campaign/journal.py`` itself, ``campaign/scheduler.py``,
+         ``fleet/dispatch.py``) may append, ahead of the
+         coordinator-HA refactor. Locks don't excuse it (a second
+         writer under a lock is still a second writer); escape with
+         the standard ``# codelint: ok`` pragma.
 """
 
 from __future__ import annotations
@@ -39,7 +48,22 @@ import re
 from .diagnostics import ERROR, WARNING, diag
 
 __all__ = ["lint_source", "lint_paths", "threaded_modules",
-           "MUTATOR_METHODS"]
+           "MUTATOR_METHODS", "JOURNAL_METHODS",
+           "JOURNAL_WRITER_FILES"]
+
+#: campaign-journal append methods: CL004 flags calls to these from
+#: any framework module outside the coordinator role
+JOURNAL_METHODS = frozenset({"append_cell", "append_event"})
+
+#: path suffixes of the modules that ARE the coordinator role -- the
+#: only legal journal-append call sites (journal.py holds the
+#: implementation; scheduler.py and dispatch.py are the two
+#: coordinators)
+JOURNAL_WRITER_FILES = (
+    os.path.join("campaign", "journal.py"),
+    os.path.join("campaign", "scheduler.py"),
+    os.path.join("fleet", "dispatch.py"),
+)
 
 #: method names that mutate their receiver in place
 MUTATOR_METHODS = frozenset({
@@ -166,9 +190,53 @@ def _line_has_pragma(lines, lineno):
     return False
 
 
-def lint_source(source, filename="<string>", threaded=True):
+def _pragma_above(lines, lineno):
+    """The pragma on the statement's own line or anywhere in the
+    comment block directly above it."""
+    if _line_has_pragma(lines, lineno):
+        return True
+    ln = lineno - 1
+    while ln >= 1 and lines[ln - 1].lstrip().startswith("#"):
+        if _PRAGMA in lines[ln - 1]:
+            return True
+        ln -= 1
+    return False
+
+
+def _journal_call_diags(tree, lines, filename):
+    """CL004: journal-append calls in a non-coordinator module. Always
+    error severity -- this is a protocol violation, not a data race,
+    and holding a lock doesn't make a second writer legal."""
+    diags = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in JOURNAL_METHODS):
+            continue
+        if _pragma_above(lines, node.lineno):
+            continue
+        diags.append(diag(
+            "CL004", ERROR,
+            f"campaign-journal write '{f.attr}' outside the "
+            "coordinator role: cells.jsonl has exactly one writer "
+            "(the invariant fleetlint FL004 audits from the journal "
+            "itself)",
+            f"{filename}:{node.lineno}",
+            "route the record through the coordinator "
+            "(campaign/scheduler.py or fleet/dispatch.py), or mark "
+            "a deliberate exception with '# codelint: ok'"))
+    return diags
+
+
+def lint_source(source, filename="<string>", threaded=True,
+                journal_calls=False):
     """Lint one module's source. ``threaded`` selects error (module is
-    reachable from a threaded path) vs warning severity."""
+    reachable from a threaded path) vs warning severity;
+    ``journal_calls=True`` additionally applies the CL004
+    coordinator-role check (lint_paths turns it on for package
+    modules outside JOURNAL_WRITER_FILES)."""
     sev = ERROR if threaded else WARNING
     try:
         tree = ast.parse(source, filename=filename)
@@ -295,6 +363,8 @@ def lint_source(source, filename="<string>", threaded=True):
                 if isinstance(sub, (ast.FunctionDef,
                                     ast.AsyncFunctionDef)):
                     visit_fn(sub, node.name)
+    if journal_calls:
+        diags += _journal_call_diags(tree, lines, filename)
     return diags
 
 
@@ -433,6 +503,16 @@ def lint_paths(paths, package_root=None):
             continue
         is_threaded = threaded is None \
             or os.path.abspath(path) in threaded
+        # CL004 applies to FRAMEWORK modules only (tests/tools forge
+        # journals legitimately), and not to the coordinator-role
+        # files themselves
+        ap = os.path.abspath(path)
+        in_package = bool(package_root) and ap.startswith(
+            os.path.abspath(package_root))
+        journal_calls = in_package and not any(
+            ap.endswith(os.sep + suffix) or ap.endswith(suffix)
+            for suffix in JOURNAL_WRITER_FILES)
         diags.extend(lint_source(src, filename=path,
-                                 threaded=is_threaded))
+                                 threaded=is_threaded,
+                                 journal_calls=journal_calls))
     return diags

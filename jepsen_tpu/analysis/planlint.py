@@ -66,6 +66,12 @@ Codes:
                  errors; a campaign trace merge requested with
                  artifact sync explicitly disabled, so the merge has
                  no mirrored per-run traces to fold (warning)
+  PL018 error    fleetlint gate: --resume requested while the
+                 campaign journal fails fleetlint's preflight
+                 well-formedness subset (duplicate terminal record /
+                 second journal writer -- resuming would build on a
+                 journal whose folds cannot be trusted), or a
+                 bad/unknown --fleetlint knob value
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -84,8 +90,9 @@ from .histlint import model_op_set
 logger = logging.getLogger(__name__)
 
 __all__ = ["lint_plan", "lint_campaign", "lint_fleet", "lint_service",
-           "lint_telemetry", "preflight", "PlanLintError",
-           "FATAL_CODES", "monitor_diags", "searchplan_diags"]
+           "lint_telemetry", "lint_fleetlint", "preflight",
+           "PlanLintError", "FATAL_CODES", "FLEETLINT_MODES",
+           "monitor_diags", "searchplan_diags"]
 
 #: error codes certain enough to abort the run before node contact
 FATAL_CODES = {"PL001", "PL003", "PL004", "PL005", "PL006"}
@@ -688,6 +695,46 @@ def lint_telemetry(cfg):
             "the coordinator lane",
             "telemetry.trace-merge",
             "re-enable artifact sync, or pass --no-trace-merge"))
+    return diags
+
+
+#: the --fleetlint knob's legal values: "on" audits the campaign at
+#: finalize AND preflights --resume; "off" skips both
+FLEETLINT_MODES = ("on", "off")
+
+
+def lint_fleetlint(cfg):
+    """PL018: the fleetlint gate. Recognized keys: ``fleetlint`` (the
+    knob value), ``resume?``, and ``journal-diags`` (the Diagnostic
+    list fleetlint.preflight produced over the journal about to be
+    resumed). An error-severity journal finding under --resume is a
+    refusal: the resume fold (skip-terminal, re-run-aborted) is only
+    sound over a journal with one writer and one terminal record per
+    cell, so resuming a journal that fails that subset would build new
+    state on corrupt truth. Each refusal names the offending cell in
+    its location so the operator knows what to quarantine."""
+    diags = []
+    cfg = cfg or {}
+    mode = cfg.get("fleetlint")
+    if mode is not None and str(mode) not in FLEETLINT_MODES:
+        diags.append(diag(
+            "PL018", ERROR,
+            f"unknown --fleetlint value {mode!r}: known modes are "
+            f"{list(FLEETLINT_MODES)}",
+            "fleet.fleetlint",
+            "'on' (default) audits the campaign at finalize and "
+            "preflights --resume; 'off' skips both"))
+    if cfg.get("resume?"):
+        for d in cfg.get("journal-diags") or []:
+            if d.severity != ERROR:
+                continue
+            diags.append(diag(
+                "PL018", ERROR,
+                f"--resume over a journal that fails the fleetlint "
+                f"preflight ({d.code}): {d.message}",
+                d.location,
+                d.fix_hint or "repair or quarantine the offending "
+                              "cell's records before resuming"))
     return diags
 
 

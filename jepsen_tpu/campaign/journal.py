@@ -25,15 +25,27 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import threading
 
 from .. import store
 
-__all__ = ["CampaignJournal"]
+__all__ = ["CampaignJournal", "writer_id"]
 
 META_FILE = "campaign.json"
 CELLS_FILE = "cells.jsonl"
 REPORT_FILE = "report.json"
+
+
+def writer_id():
+    """THIS process's journal-writer identity (``host:pid``). Every
+    appended record is stamped with it, which is what lets the
+    fleetlint auditor prove the single-writer invariant from the
+    journal alone: two coordinators appending concurrently leave
+    interleaved writer identities (FL004) -- the oracle the planned
+    coordinator-HA handoff will be soaked against. A resumed campaign
+    legitimately has a NEW writer; its records form a contiguous run."""
+    return f"{socket.gethostname()}:{os.getpid()}"
 
 
 class CampaignJournal:
@@ -44,6 +56,7 @@ class CampaignJournal:
         self.campaign_id = str(campaign_id)
         self.dir = store.campaign_path(self.campaign_id)
         os.makedirs(self.dir, exist_ok=True)
+        self.writer = writer_id()
         self._lock = threading.Lock()
 
     # -- paths ----------------------------------------------------------
@@ -102,6 +115,11 @@ class CampaignJournal:
         self._append_line(record)
 
     def _append_line(self, record):
+        # stamp the writer identity unless the caller already chose one
+        # (golden-journal test fixtures forge foreign writers on
+        # purpose); setdefault on a copy -- the caller's dict is theirs
+        record = dict(record)
+        record.setdefault("writer", self.writer)
         line = json.dumps(record, cls=store._Encoder)
         with self._lock:
             torn = False

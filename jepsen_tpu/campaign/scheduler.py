@@ -126,7 +126,7 @@ def _outcome_of(test, latch):
 
 def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
               resume=False, latch=None, run_fn=None, ledger=True,
-              backends=None):
+              backends=None, fleetlint=True):
     """Run a campaign; returns the aggregated report dict (also
     persisted as report.json in the campaign directory).
 
@@ -166,6 +166,22 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
         raise CampaignError(
             f"campaign {campaign_id!r} already exists: pass --resume "
             "to continue it, or pick a new --campaign-id")
+    if resume and fleetlint:
+        # fleetlint preflight before TRUSTING the journal (PL018):
+        # the skip-terminal resume fold is only sound over a journal
+        # with one writer and one terminal record per cell
+        from ..analysis import fleetlint as flint
+        from ..analysis import planlint, render_text
+        from ..analysis import errors as diag_errors
+        pf = planlint.lint_fleetlint({
+            "resume?": True,
+            "journal-diags": flint.preflight(campaign_id,
+                                             records=jr.records())})
+        if diag_errors(pf):
+            raise CampaignError(render_text(
+                diag_errors(pf),
+                title="--resume refused: journal fails the fleetlint "
+                      "preflight:"))
     done = jr.completed() if resume else {}
     if resume:
         # compare EVERY journaled cell (terminal or aborted) against
@@ -388,6 +404,23 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
         jr.write_meta({**(jr.load_meta() or {}),
                        "status": "aborted" if aborted else "complete",
                        "updated": store.local_time()})
+        if fleetlint:
+            try:
+                # control-plane audit (analysis.fleetlint): scheduler
+                # campaigns have no leases, but the terminal-guard
+                # and single-writer invariants hold here too.
+                # CONTAINED -- findings are reported, never allowed
+                # to change an outcome or the exit code
+                from ..analysis import fleetlint as flint
+                fa, _diags = flint.audit(campaign_id)
+                report["fleet_analysis"] = {"counts": fa["counts"],
+                                            "checks": fa["checks"],
+                                            "path": fa.get("path")}
+                jr.write_report(report)
+            except Exception:  # noqa: BLE001 - audit is contained
+                logger.warning("fleetlint audit of campaign %s "
+                               "crashed (contained)", campaign_id,
+                               exc_info=True)
         if hard_abort is not None:
             raise hard_abort
         return report

@@ -447,6 +447,16 @@ def _add_campaign_opts(parser, axes=False):
                                  "finalize (the merged Perfetto "
                                  "timeline with one lane per worker, "
                                  "clocks skew-normalized).")
+        parser.add_argument("--fleetlint", default="on",
+                            metavar="MODE",
+                            help="Control-plane audit mode: 'on' "
+                                 "(default) replays the finished "
+                                 "campaign's artifacts against the "
+                                 "fleet protocol (analysis.fleetlint "
+                                 "-> fleet_analysis.json) and "
+                                 "preflights --resume; 'off' skips "
+                                 "both. Unknown values are a PL018 "
+                                 "error.")
         parser.add_argument("--chaos-profile", default=None,
                             metavar="NAME[:SEED]",
                             help="Fleet chaos soak: inject a seeded, "
@@ -551,7 +561,7 @@ _FLEET_LOCAL_OPTS = {
     "argv", "workers", "lease", "max-leases", "serve", "serve-port",
     "serve-ip",
     "auth-token", "worker-store", "sync-timeout", "chaos-profile",
-    "no-ledger", "backends", "axis", "seeds", "parallel",
+    "fleetlint", "no-ledger", "backends", "axis", "seeds", "parallel",
     "device-slots", "campaign-id", "resume", "lint?",
 }
 
@@ -599,6 +609,22 @@ def campaign_cmd(opts):
 
         from . import campaign
         from . import analysis
+
+        # --lint with an EXISTING --campaign-id audits that campaign
+        # from disk (fleetlint over its journal/traces) instead of dry
+        # running a matrix: `campaign --lint --campaign-id soak` is
+        # the post-hoc "did the control plane behave?" question
+        import os
+        cid = options.get("campaign-id")
+        if options.get("lint?") and cid \
+                and os.path.exists(store.campaign_path(cid,
+                                                       "campaign.json")):
+            from .analysis import fleetlint
+            _report, diags = fleetlint.audit(cid)
+            print(analysis.render_text(
+                diags, title=f"fleetlint audit: {cid}"))
+            sys.exit(1 if analysis.errors(diags) else 0)
+
         axes = parse_axes(options.get("axis"), options.get("seeds"))
         matrix = {"axes": axes}
         cells_plan = campaign.plan.expand(matrix)
@@ -656,6 +682,10 @@ def campaign_cmd(opts):
         # searchplan knob preflight (PL015) rides along over the base
         # options every cell is built from, mirroring run_fleet
         diags += analysis.planlint.searchplan_diags(options)
+        # fleetlint knob preflight (PL018, knob half) rides the same
+        # way; the journal half runs inside run_fleet's resume path
+        diags += analysis.planlint.lint_fleetlint(
+            {"fleetlint": options.get("fleetlint")})
         if options.get("lint?"):
             print(analysis.render_text(diags, title="campaign lint:"))
             for c in cells_plan:
@@ -692,7 +722,8 @@ def campaign_cmd(opts):
                     chaos=options.get("chaos-profile"),
                     serve_ip=options.get("serve-ip"),
                     auth_token=options.get("auth-token"),
-                    trace_merge=not options.get("no-trace-merge"))
+                    trace_merge=not options.get("no-trace-merge"),
+                    fleetlint=options.get("fleetlint") or "on")
             except fleet.FleetError as e:
                 raise CliError(str(e)) from e
             print(campaign.report.render_text(report))
@@ -729,7 +760,8 @@ def campaign_cmd(opts):
                 campaign_id=options.get("campaign-id"),
                 resume=bool(options.get("resume")),
                 ledger=not options.get("no-ledger"),
-                backends=options.get("backends") or None)
+                backends=options.get("backends") or None,
+                fleetlint=options.get("fleetlint") != "off")
         except campaign.CampaignError as e:
             raise CliError(str(e)) from e
         print(campaign.report.render_text(report))

@@ -150,7 +150,8 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
               backends=None, python=None, cwd=None, serve=False,
               device_slots=1, probe=True, env=None, sync="auto",
               worker_store_dir=None, sync_timeout_s=None, chaos=None,
-              serve_ip=None, auth_token=None, trace_merge=True):
+              serve_ip=None, auth_token=None, trace_merge=True,
+              fleetlint="on"):
     """Run a campaign across worker hosts; returns the report dict
     (persisted as report.json, same shape as scheduler.run_cells).
 
@@ -188,7 +189,19 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     tracer/registry are also bound process-globally for the
     campaign's duration, so chaos injections, sync pulls, and probes
     emit first-class events, and registered /api/metrics sources
-    serve the live lease/queue gauges."""
+    serve the live lease/queue gauges.
+
+    **Audit** (``fleetlint``): ``"on"`` (default) replays the
+    finished campaign's artifacts against the control-plane protocol
+    (analysis.fleetlint -- terminal-guard, single journal writer,
+    lease lifecycle, sync manifests, trace causality, chaos
+    accounting) into ``fleet_analysis.json``, and preflights
+    ``--resume`` with the well-formedness subset, refusing (PL018) to
+    resume a journal with duplicate terminal records or interleaved
+    writers. ``"off"`` skips both. The finalize audit is CONTAINED:
+    findings (and auditor crashes) are reported, never allowed to
+    flip a cell outcome or the campaign's exit code -- the same rule
+    searchplan follows for verdicts."""
     from ..analysis import planlint, render_text, errors as diag_errors
     from . import sync as fsync
 
@@ -231,6 +244,9 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     # (unknown predicate names, carry disabled under the monitor)
     # surface before any host is contacted
     diags += planlint.searchplan_diags(base_options)
+    # PL018 (knob half): an unknown --fleetlint value is an error
+    # here, not a silently-skipped audit
+    diags += planlint.lint_fleetlint({"fleetlint": fleetlint})
     if diags:
         logger.warning("%s", render_text(diags,
                                          title="fleet preflight:"))
@@ -256,6 +272,22 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         raise FleetError(
             f"campaign {campaign_id!r} already exists: pass --resume "
             "to continue it, or pick a new --campaign-id")
+    if resume and fleetlint != "off":
+        # preflight before TRUSTING the journal: the resume fold
+        # (skip-terminal, re-run-aborted) is only sound over a
+        # well-formed journal -- duplicate terminal records or
+        # interleaved writers mean the folds lie, and resuming would
+        # append new truth onto corrupt truth (PL018)
+        from ..analysis import fleetlint as flint
+        pf = planlint.lint_fleetlint({
+            "resume?": True,
+            "journal-diags": flint.preflight(campaign_id,
+                                             records=jr.records())})
+        if diag_errors(pf):
+            raise FleetError(render_text(
+                diag_errors(pf),
+                title="--resume refused: journal fails the fleetlint "
+                      "preflight:"))
     done = jr.completed() if resume else {}
     jr.write_meta({
         "status": "running", "mode": "fleet",
@@ -264,6 +296,7 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         "cells": ids,
         "workers": [w.id for w in workers],
         "lease-s": lease_s,
+        "max-leases": max_leases,
         "sync-timeout-s": sync_timeout_s,
         **({"worker-store": str(worker_store_dir)}
            if worker_store_dir else {}),
@@ -467,13 +500,18 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
 
     def journal_sync(cell, wid, status, info=None, **extra):
         """One ``artifact-sync`` event record + metric (the sync_rec
-        and resume-resync paths must journal identically)."""
+        and resume-resync paths must journal identically). The
+        verified manifest rides on success records so fleetlint can
+        re-verify the mirrored copy against the journaled sizes
+        (FL008); attempt counts ride on both outcomes so injected
+        sync faults stay accountable (FL013)."""
         reg.inc("fleet.artifact_syncs", status=status,
                 worker=str(wid))
         jr.append_event({"event": "artifact-sync", "cell": cell,
                          "worker": wid, "status": status,
                          **{k: info[k] for k in
-                            ("files", "bytes", "attempts", "wall_s")
+                            ("files", "bytes", "attempts", "wall_s",
+                             "manifest")
                             if info and k in info},
                          **extra, "t": store.local_time()})
 
@@ -487,7 +525,9 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
 
         def failed(err):
             journal_sync(lease.unit, worker.id, "failed",
-                         error=str(err)[:300])
+                         error=str(err)[:300],
+                         **({"attempts": err.attempts}
+                            if getattr(err, "attempts", 0) else {}))
             rec["synced"] = False
             # journal how to reach this worker's store: a later
             # --resume may run with a DIFFERENT worker list, and the
@@ -569,8 +609,17 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
             # if the lease already expired, the steal re-runs the cell
             # into a FRESH run dir, so a late sync can't collide).
             # Small pad past the pull's own deadline: the verify +
-            # rename + journal tail must not lose a lease race
+            # rename + journal tail must not lose a lease race. The
+            # extension is journaled -- fleetlint checks that extends
+            # happen only to cover an artifact sync (FL00x lease
+            # lifecycle), the one legitimate reason a finished cell
+            # may outlive its TTL
             table.extend(lease, sync_timeout_s + 5.0)
+            jr.append_event({"event": "lease-extend", "cell": cid,
+                             "worker": worker.id,
+                             "ttl-s": sync_timeout_s + 5.0,
+                             "reason": "artifact-sync",
+                             "t": store.local_time()})
             with tr.span("fleet.artifact_sync", cat="fleet",
                          args={"cell": cid, "worker": worker.id}):
                 sync_err = sync_rec(worker, conn, lease, rec)
@@ -728,7 +777,10 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                                       timeout_s=sync_timeout_s)
             except Exception as exc:  # noqa: BLE001 - per-cell
                 journal_sync(cid, wid, "failed",
-                             error=str(exc)[:300])
+                             error=str(exc)[:300],
+                             **({"attempts": exc.attempts}
+                                if getattr(exc, "attempts", 0)
+                                else {}))
                 fsync.register_pending(rel, kind=kind,
                                        conn_spec=conn_spec,
                                        remote_dir=rec["worker-path"],
@@ -885,6 +937,34 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         jr.write_meta({**(jr.load_meta() or {}),
                        "status": "aborted" if aborted else "complete",
                        "updated": store.local_time()})
+        if fleetlint != "off":
+            # the control-plane audit: replay everything this campaign
+            # just journaled/traced against the protocol's invariants.
+            # CONTAINED like searchplan: findings (and auditor
+            # crashes) are reported in report.json and the FL
+            # artifact, never allowed to flip a cell outcome or the
+            # campaign exit code
+            try:
+                from ..analysis import fleetlint as flint
+                from ..analysis.diagnostics import run_analyzer
+                fa = None
+
+                def _run_audit():
+                    nonlocal fa
+                    fa, diags_ = flint.audit(campaign_id)
+                    return diags_
+
+                run_analyzer("fleetlint", _run_audit)
+                report["fleet_analysis"] = {
+                    "counts": fa["counts"],
+                    "checks": fa["checks"],
+                    "path": fa.get("path"),
+                }
+                jr.write_report(report)
+            except Exception:  # noqa: BLE001 - audit is contained
+                logger.warning("fleetlint audit of campaign %s "
+                               "crashed (contained)", campaign_id,
+                               exc_info=True)
         if hard_abort is not None:
             raise hard_abort
         return report

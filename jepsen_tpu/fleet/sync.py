@@ -57,7 +57,12 @@ DEFAULT_SYNC_TIMEOUT_S = 120.0
 
 class SyncError(RuntimeError):
     """One sync attempt failed (transport error, manifest mismatch,
-    rename race). Retried under the policy; terminal after that."""
+    rename race). Retried under the policy; terminal after that.
+    ``attempts`` (set by pull_run on the terminal raise) records how
+    many attempts were burned, so the journal's ``artifact-sync``
+    failure event can account for every injected fault it absorbed."""
+
+    attempts = 0
 
 
 def resolve_remote(kind):
@@ -167,12 +172,20 @@ def pull_run(conn, remote_dir, dest, *, timeout_s=DEFAULT_SYNC_TIMEOUT_S,
                 if not os.path.isdir(dest):   # a real rename failure
                     raise SyncError(f"couldn't publish sync: {e}") \
                         from None
-            return {"files": len(man), "bytes": sum(man.values())}
+            # the manifest rides in the result so the dispatcher can
+            # journal it: fleetlint re-verifies the mirrored copy
+            # against these sizes post hoc (FL008)
+            return {"files": len(man), "bytes": sum(man.values()),
+                    "manifest": dict(man)}
         finally:
             shutil.rmtree(tmp_root, ignore_errors=True)
 
-    out = policy.call(attempt, retry_on_exception=SyncError,
-                      site="fleet.artifact_sync")
+    try:
+        out = policy.call(attempt, retry_on_exception=SyncError,
+                          site="fleet.artifact_sync")
+    except SyncError as e:
+        e.attempts = attempts
+        raise
     out["attempts"] = attempts
     out["wall_s"] = round(time.monotonic() - t0, 3)
     return out

@@ -571,7 +571,7 @@ def load_campaign(campaign_id):
     return out
 
 
-def latest_campaign_records(campaign_id):
+def latest_campaign_records(campaign_id, records=None):
     """One record per cell, latest wins -- THE fold every consumer of
     the journal must agree on (resume skipping, the final report, the
     web view): a resumed campaign's journal keeps superseded records
@@ -581,20 +581,41 @@ def latest_campaign_records(campaign_id):
     jepsen_tpu.fleet.dispatch) are NOT outcomes and never participate
     in this fold -- a lease line after a terminal record must not
     resurrect the cell, and a lease with no terminal record must not
-    read as completed. ``campaign_events`` reads them instead."""
+    read as completed. ``campaign_events`` reads them instead.
+
+    ``records`` takes pre-parsed journal records so callers that need
+    BOTH folds (fleetlint, the campaign report) read and torn-tail-skip
+    ``cells.jsonl`` exactly once -- ``load_campaign_records`` is the
+    only place that ever touches the file."""
+    if records is None:
+        records = load_campaign_records(campaign_id)
+    return fold_latest_records(records)
+
+
+def fold_latest_records(records):
+    """The latest-per-cell outcome fold over pre-parsed records (the
+    pure half of ``latest_campaign_records``)."""
     latest = {}
-    for rec in load_campaign_records(campaign_id):
+    for rec in records:
         if rec.get("event"):
             continue
         latest[rec.get("cell")] = rec
     return list(latest.values())
 
 
-def campaign_events(campaign_id):
+def campaign_events(campaign_id, records=None):
     """The journal's event records (lease grants/failures appended by
-    the fleet dispatcher), append order."""
-    return [rec for rec in load_campaign_records(campaign_id)
-            if rec.get("event")]
+    the fleet dispatcher), append order. ``records`` takes pre-parsed
+    journal records (see ``latest_campaign_records``)."""
+    if records is None:
+        records = load_campaign_records(campaign_id)
+    return fold_event_records(records)
+
+
+def fold_event_records(records):
+    """The event-record filter over pre-parsed records (the pure half
+    of ``campaign_events``)."""
+    return [rec for rec in records if rec.get("event")]
 
 
 def load_campaign_records(campaign_id):

@@ -208,6 +208,14 @@ def _utilization_rows(cid, records):
     return per
 
 
+def _audit_header(cid):
+    """The persisted fleetlint report's headline (counts), or None
+    when the campaign was never audited."""
+    from .analysis import fleetlint
+    fa = fleetlint.load_report(cid)
+    return fa if isinstance(fa, dict) else None
+
+
 def _campaigns_page():
     """Campaign index: one section per campaign, its runs grouped by
     cell (web's view of store/campaigns/<id>/). Fleet campaigns
@@ -244,6 +252,19 @@ def _campaigns_page():
                 f"</tr>")
         planned = len(meta.get("cells") or [])
         files = f"/files/{store.CAMPAIGNS_DIR}/{urllib.parse.quote(cid)}/"
+        # the control-plane audit verdict (analysis.fleetlint, written
+        # at fleet finalize): clean / N errors, linked to the full
+        # fleet_analysis.json report
+        audit_line = ""
+        fa = _audit_header(cid)
+        if fa is not None:
+            c = fa.get("counts") or {}
+            verdict = "clean" if not c.get("error") else (
+                f"{c.get('error', 0)} error(s), "
+                f"{c.get('warning', 0)} warning(s)")
+            audit_line = (f' &mdash; audit: <a href="{files}'
+                          f'fleet_analysis.json">'
+                          f"{html.escape(verdict)}</a>")
         trace_link = ""
         if os.path.exists(store.campaign_path(cid,
                                               "campaign_trace.jsonl")):
@@ -268,7 +289,7 @@ def _campaigns_page():
             f'<h2><a href="{files}">{html.escape(cid)}</a></h2>'
             f"<p>status: {html.escape(str(meta.get('status')))} &mdash; "
             f"{len(records)}/{planned} cells ({html.escape(badge)})"
-            f"{trace_link}</p>{util_table}"
+            f"{audit_line}{trace_link}</p>{util_table}"
             f"<table><thead><tr><th>Cell</th><th>Outcome</th>"
             f"<th>Valid?</th><th>Run</th><th>Wall (s)</th></tr></thead>"
             f"<tbody>{''.join(rows)}</tbody></table>")

@@ -529,6 +529,16 @@ def test_chaos_soak_acceptance(tmp_path):
     # the chaos-torn ledger tail was tolerated
     st = fledger.Ledger(store.compile_ledger_path()).stats()
     assert st["processes"] >= 1
+    # the soak is a real oracle now: the finalize audit
+    # (analysis.fleetlint) replayed the journal, sync manifests, and
+    # run traces and must find ZERO errors -- every injected fault
+    # accounted, every lease lifecycle legal, every mirror verified
+    fa = rep["fleet_analysis"]
+    assert fa["counts"]["error"] == 0, fa
+    assert fa["counts"]["warning"] == 0, fa
+    assert fa["checks"]["runs_audited"] == 2, fa
+    from jepsen_tpu.analysis import fleetlint
+    assert fleetlint.load_report("soak")["counts"] == fa["counts"]
 
 
 # ---------------------------------------------------------------------------

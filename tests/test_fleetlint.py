@@ -1,0 +1,723 @@
+"""fleetlint tests: the control-plane auditor over golden
+corrupted-journal fixtures (each defect class -> its FL code), the
+--resume preflight gate (PL018), the CL004 journal-writer codelint
+pass, the shared store journal folds, and the loopback fleet
+acceptance run (clean audit, byte-deterministic artifact,
+containment: the audit can never alter an outcome or exit code)."""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from jepsen_tpu import checker as cc
+from jepsen_tpu import cli
+from jepsen_tpu import client as jc
+from jepsen_tpu import generator as gen
+from jepsen_tpu import store
+from jepsen_tpu import tests as tst
+from jepsen_tpu.analysis import codelint, fleetlint, planlint
+from jepsen_tpu.analysis.diagnostics import ERROR, WARNING
+from jepsen_tpu.analysis.fleetmodel import CampaignModel
+from jepsen_tpu.campaign import scheduler
+from jepsen_tpu.campaign.journal import CampaignJournal
+from jepsen_tpu.fleet import dispatch
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+def _error_codes(diags):
+    return [d.code for d in diags if d.severity == ERROR]
+
+
+# ---------------------------------------------------------------------------
+# golden-journal helpers
+
+def mk_fleet(cid, cells=("a", "b"), status="complete", **extra):
+    jr = CampaignJournal(cid)
+    jr.write_meta({"status": status, "mode": "fleet",
+                   "cells": list(cells), "workers": ["w1"],
+                   "lease-s": 60.0, "max-leases": 3, **extra})
+    return jr
+
+
+def grant(jr, cell, worker="w1", attempt=1, t=None):
+    jr.append_event({"event": "lease", "cell": cell, "worker": worker,
+                     "attempt": attempt, "lease-s": 60.0,
+                     "t": t or store.local_time()})
+
+
+def forfeit(jr, cell, worker="w1"):
+    jr.append_event({"event": "lease-failed", "cell": cell,
+                     "worker": worker, "error": "injected",
+                     "t": store.local_time()})
+
+
+def terminal(jr, cell, worker="w1", attempt=1, **kw):
+    jr.append_cell({"cell": cell, "group": cell, "params": {},
+                    "outcome": True, "valid": True, "worker": worker,
+                    "attempt": attempt, **kw})
+
+
+def leased_terminal(jr, cell, **kw):
+    grant(jr, cell)
+    terminal(jr, cell, **kw)
+
+
+def clean_fleet(cid):
+    jr = mk_fleet(cid)
+    leased_terminal(jr, "a")
+    leased_terminal(jr, "b")
+    return jr
+
+
+# ---------------------------------------------------------------------------
+# journal well-formedness
+
+
+def test_clean_synthetic_journal_has_no_findings():
+    clean_fleet("clean")
+    diags = fleetlint.lint_campaign("clean")
+    # runs aren't on disk in this fixture: only the coverage info
+    assert _codes(diags) == ["FL014"]
+    assert not _error_codes(diags)
+
+
+def test_fl001_duplicate_terminal_record():
+    jr = clean_fleet("dup")
+    terminal(jr, "a")            # terminal-guard bypassed
+    diags = fleetlint.lint_campaign("dup")
+    assert "FL001" in _error_codes(diags)
+    assert any("cells[a]" in d.location for d in diags
+               if d.code == "FL001")
+    # an aborted + re-run cell is ONE terminal record, not a duplicate
+    jr2 = mk_fleet("rerun", cells=["x"])
+    jr2.append_cell({"cell": "x", "outcome": "aborted"})
+    grant(jr2, "x")
+    terminal(jr2, "x")
+    assert "FL001" not in _codes(fleetlint.lint_campaign("rerun"))
+
+
+def test_fl002_unplanned_cell_and_fl003_missing_terminal():
+    jr = mk_fleet("plan", cells=["a", "b"])
+    leased_terminal(jr, "a")
+    leased_terminal(jr, "ghost")   # not in the planned set
+    diags = fleetlint.lint_campaign("plan")
+    assert "FL002" in _error_codes(diags)
+    assert "FL003" in _error_codes(diags)   # b never landed terminal
+    # an ABORTED campaign is allowed unfinished cells
+    jr2 = mk_fleet("ab", cells=["a", "b"], status="aborted")
+    leased_terminal(jr2, "a")
+    assert "FL003" not in _codes(fleetlint.lint_campaign("ab"))
+
+
+def test_fl004_second_writer_interleaving():
+    jr = mk_fleet("writers", cells=["a", "b"], resumes=1)
+    grant(jr, "a")
+    jr.append_cell({"cell": "a", "outcome": True, "worker": "w1",
+                    "attempt": 1, "writer": "hostA:1"})
+    jr.append_event({"event": "lease", "cell": "b", "worker": "w1",
+                     "attempt": 1, "t": store.local_time(),
+                     "writer": "hostB:2"})
+    # hostA appends AFTER hostB took over: two live coordinators
+    jr.append_cell({"cell": "b", "outcome": True, "worker": "w1",
+                    "attempt": 1, "writer": "hostA:1"})
+    diags = fleetlint.lint_campaign("writers")
+    assert "FL004" in _error_codes(diags)
+    # contiguous handoff with a journaled resume is legal
+    jr2 = mk_fleet("handoff", cells=["a", "b"], resumes=1)
+    jr2.append_cell({"cell": "a", "outcome": True, "writer": "hostA:1"})
+    jr2.append_cell({"cell": "b", "outcome": True, "writer": "hostB:2"})
+    assert not _error_codes([d for d in
+                             fleetlint.lint_campaign("handoff")
+                             if d.code == "FL004"])
+
+
+def test_fl004_warns_on_unexplained_writer_count():
+    jr = mk_fleet("unexplained", cells=["a", "b"])   # resumes = 0
+    jr.append_cell({"cell": "a", "outcome": True, "writer": "hostA:1"})
+    jr.append_cell({"cell": "b", "outcome": True, "writer": "hostB:2"})
+    diags = [d for d in fleetlint.lint_campaign("unexplained")
+             if d.code == "FL004"]
+    assert diags and all(d.severity == WARNING for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# lease lifecycle
+
+
+def test_fl005_result_without_a_lease():
+    jr = mk_fleet("nolease", cells=["a"])
+    terminal(jr, "a")            # no grant at all
+    assert "FL005" in _error_codes(fleetlint.lint_campaign("nolease"))
+    # a grant to a DIFFERENT worker doesn't cover it either
+    jr2 = mk_fleet("wrongworker", cells=["a"])
+    grant(jr2, "a", worker="w1")
+    terminal(jr2, "a", worker="w9")
+    assert "FL005" in _error_codes(
+        fleetlint.lint_campaign("wrongworker"))
+
+
+def test_fl006_lease_budget_overrun():
+    jr = mk_fleet("budget", cells=["a"])   # max-leases 3
+    for attempt in range(1, 5):
+        grant(jr, "a", attempt=attempt)
+        if attempt < 4:
+            forfeit(jr, "a")
+    terminal(jr, "a", attempt=4)
+    assert "FL006" in _error_codes(fleetlint.lint_campaign("budget"))
+
+
+def test_fl007_overlapping_leases_need_a_forfeit_between():
+    jr = mk_fleet("overlap", cells=["a"])
+    grant(jr, "a", worker="w1", attempt=1)
+    grant(jr, "a", worker="w2", attempt=2)   # no forfeit between
+    terminal(jr, "a", worker="w2", attempt=2)
+    assert "FL007" in _error_codes(fleetlint.lint_campaign("overlap"))
+    # with the forfeit journaled, the steal is legal
+    jr2 = mk_fleet("steal", cells=["a"])
+    grant(jr2, "a", worker="w1", attempt=1)
+    forfeit(jr2, "a")
+    grant(jr2, "a", worker="w2", attempt=2)
+    terminal(jr2, "a", worker="w2", attempt=2)
+    assert "FL007" not in _codes(fleetlint.lint_campaign("steal"))
+
+
+def test_fl007_and_fl006_tolerate_a_crash_resume():
+    """A coordinator killed holding a live lease can never journal
+    the forfeit; the resumed session's re-grant (NEW writer) is an
+    implicit forfeit, not two live leases -- and the lease budget
+    counts per coordinator session (the dispatcher's attempt counter
+    starts fresh on --resume)."""
+    jr = mk_fleet("crashresume", cells=["a"], resumes=1)
+    jr.append_event({"event": "lease", "cell": "a", "worker": "w1",
+                     "attempt": 1, "t": store.local_time(),
+                     "writer": "hostA:1"})
+    jr.append_event({"event": "lease", "cell": "a", "worker": "w1",
+                     "attempt": 2, "t": store.local_time(),
+                     "writer": "hostA:1"})   # same writer, no forfeit
+    # sanity: the same-writer shape IS still a violation
+    assert "FL007" in _codes(fleetlint.lint_campaign("crashresume"))
+    # rebuild as a crash-resume: second grant from a NEW writer
+    jr2 = mk_fleet("crashresume2", cells=["a"], resumes=1)
+    jr2.append_event({"event": "lease", "cell": "a", "worker": "w1",
+                      "attempt": 1, "t": store.local_time(),
+                      "writer": "hostA:1"})
+    for attempt in (1, 2, 3):
+        jr2.append_event({"event": "lease", "cell": "a",
+                          "worker": "w1", "attempt": attempt,
+                          "t": store.local_time(),
+                          "writer": "hostB:2",
+                          **({} if attempt == 1 else {})})
+        if attempt < 3:
+            jr2.append_event({"event": "lease-failed", "cell": "a",
+                              "worker": "w1", "error": "x",
+                              "t": store.local_time(),
+                              "writer": "hostB:2"})
+    jr2.append_cell({"cell": "a", "outcome": True, "worker": "w1",
+                     "attempt": 3, "writer": "hostB:2"})
+    diags = fleetlint.lint_campaign("crashresume2")
+    # 4 grants total but max 3 PER SESSION (1 + 3): no FL006, and
+    # the writer handoff excuses the missing forfeit: no FL007
+    assert "FL006" not in _codes(diags)
+    assert "FL007" not in _codes(diags)
+
+
+def test_fl015_lease_extend_outside_sync():
+    jr = mk_fleet("extend", cells=["a"])
+    grant(jr, "a")
+    jr.append_event({"event": "lease-extend", "cell": "a",
+                     "worker": "w1", "ttl-s": 35.0,
+                     "reason": "artifact-sync",
+                     "t": store.local_time()})
+    terminal(jr, "a")
+    diags = [d for d in fleetlint.lint_campaign("extend")
+             if d.code == "FL015"]
+    assert diags and diags[0].severity == WARNING
+    # an extend followed by its sync event is the legal shape
+    jr.append_event({"event": "artifact-sync", "cell": "a",
+                     "worker": "w1", "status": "ok", "files": 1,
+                     "t": store.local_time()})
+    assert "FL015" not in _codes(fleetlint.lint_campaign("extend"))
+
+
+# ---------------------------------------------------------------------------
+# sync consistency
+
+
+def _run_dir(name="noop/t1"):
+    d = os.path.join(store.base_dir, name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def test_fl008_synced_true_with_size_mismatched_mirror():
+    d = _run_dir()
+    with open(os.path.join(d, "results.json"), "w") as f:
+        f.write('{"valid": true}')
+    jr = mk_fleet("sync", cells=["a"])
+    grant(jr, "a")
+    jr.append_event({"event": "artifact-sync", "cell": "a",
+                     "worker": "w1", "status": "ok", "files": 1,
+                     "manifest": {"results.json": 999},
+                     "t": store.local_time()})
+    terminal(jr, "a", synced=True, path=d)
+    diags = fleetlint.lint_campaign("sync")
+    assert "FL008" in _error_codes(diags)
+    assert any("999" in d_.message for d_ in diags
+               if d_.code == "FL008")
+    # fix the manifest: clean
+    jr2 = mk_fleet("sync2", cells=["a"])
+    grant(jr2, "a")
+    jr2.append_event({"event": "artifact-sync", "cell": "a",
+                      "worker": "w1", "status": "ok", "files": 1,
+                      "manifest": {"results.json":
+                                   os.path.getsize(
+                                       os.path.join(d,
+                                                    "results.json"))},
+                      "t": store.local_time()})
+    terminal(jr2, "a", synced=True, path=d)
+    assert "FL008" not in _codes(fleetlint.lint_campaign("sync2"))
+
+
+def test_fl008_synced_true_without_event_or_dir():
+    jr = mk_fleet("noevent", cells=["a"])
+    grant(jr, "a")
+    terminal(jr, "a", synced=True, path=_run_dir("noop/t2"))
+    assert "FL008" in _error_codes(fleetlint.lint_campaign("noevent"))
+    jr2 = mk_fleet("nodir", cells=["a"])
+    grant(jr2, "a")
+    jr2.append_event({"event": "artifact-sync", "cell": "a",
+                      "worker": "w1", "status": "ok", "files": 1,
+                      "t": store.local_time()})
+    terminal(jr2, "a", synced=True,
+             path=os.path.join(store.base_dir, "noop", "missing"))
+    assert "FL008" in _error_codes(fleetlint.lint_campaign("nodir"))
+
+
+def test_fl009_sync_tmp_residue():
+    clean_fleet("tmpres")
+    staged = store.sync_tmp_path("123-456")
+    os.makedirs(staged)
+    with open(os.path.join(staged, "partial"), "w") as f:
+        f.write("torn")
+    assert "FL009" in _error_codes(fleetlint.lint_campaign("tmpres"))
+
+
+# ---------------------------------------------------------------------------
+# trace causality
+
+
+def _write_trace(run_dir, epoch_s, context, events=(), finalized=True):
+    meta = {"name": "trace_meta", "ph": "i", "cat": "__metadata",
+            "ts": 0.0, "pid": 1, "tid": 0, "s": "g",
+            "args": {"epoch_ns": int(epoch_s * 1e9),
+                     "context": context}}
+    name = "trace.jsonl" if finalized else store.TRACE_JOURNAL_FILE
+    with open(os.path.join(run_dir, name), "w") as f:
+        for ev in (meta,) + tuple(events):
+            f.write(json.dumps(ev) + "\n")
+
+
+def _span(name, ts_us, dur_us):
+    return {"name": name, "ph": "X", "cat": "lifecycle", "ts": ts_us,
+            "dur": dur_us, "pid": 1, "tid": 1}
+
+
+def _fleet_with_run(cid, epoch_s, context=None, events=(),
+                    clock=None, finalized=True):
+    d = _run_dir(f"noop/{cid}")
+    _write_trace(d, epoch_s,
+                 context if context is not None
+                 else {"campaign": cid, "cell": "a", "worker": "w1"},
+                 events, finalized=finalized)
+    jr = mk_fleet(cid, cells=["a"])
+    grant(jr, "a")
+    terminal(jr, "a", path=d,
+             clock=clock or {"worker-result-epoch": epoch_s + 100,
+                             "coord-received-epoch": epoch_s + 100})
+    return jr
+
+
+def test_fl010_worker_span_before_its_lease_grant():
+    """THE golden causality fixture: a run trace whose wall anchor
+    places jepsen.run an hour before the lease grant, under a
+    recovered clock offset of ~0 (the handshake stamps agree)."""
+    import time
+    now = time.time()
+    _fleet_with_run("early", epoch_s=now - 3600,
+                    events=(_span("jepsen.run", 0.0, 1e6),),
+                    clock={"worker-result-epoch": now,
+                           "coord-received-epoch": now})
+    diags = fleetlint.lint_campaign("early")
+    assert "FL010" in _error_codes(diags)
+    assert any("before its lease grant" in d.message for d in diags
+               if d.code == "FL010")
+
+
+def test_fl010_clean_when_span_sits_inside_the_lease():
+    import time
+    now = time.time()
+    _fleet_with_run("intime", epoch_s=now + 1.0,
+                    events=(_span("jepsen.run", 0.0, 2e6),),
+                    clock={"worker-result-epoch": now + 4.0,
+                           "coord-received-epoch": now + 4.0})
+    assert "FL010" not in _codes(fleetlint.lint_campaign("intime"))
+
+
+def test_fl010_span_closing_after_the_result_stamp():
+    import time
+    now = time.time()
+    # the run span runs 60 s on the worker's OWN clock, but the
+    # worker claims it printed its result 2 s in: exec ≺ result broken
+    _fleet_with_run("lateclose", epoch_s=now,
+                    events=(_span("jepsen.run", 0.0, 60e6),),
+                    clock={"worker-result-epoch": now + 2.0,
+                           "coord-received-epoch": now + 2.0})
+    diags = [d for d in fleetlint.lint_campaign("lateclose")
+             if d.code == "FL010"]
+    assert diags and any("after the worker printed" in d.message
+                         for d in diags)
+
+
+def test_fl011_unbalanced_async_spans_in_finalized_trace():
+    import time
+    now = time.time()
+    open_ev = {"name": "nemesis.window", "ph": "b", "cat": "nemesis",
+               "ts": 1.0, "pid": 1, "tid": 1, "id": "w0"}
+    _fleet_with_run("unbal", epoch_s=now,
+                    events=(_span("jepsen.run", 0.0, 1e6), open_ev))
+    diags = [d for d in fleetlint.lint_campaign("unbal")
+             if d.code == "FL011"]
+    assert diags and diags[0].severity == WARNING
+    # the same imbalance in a CRASH JOURNAL trace is expected, not
+    # flagged (a kill -9 legitimately truncates spans)
+    _fleet_with_run("unbal2", epoch_s=now,
+                    events=(_span("jepsen.run", 0.0, 1e6), open_ev),
+                    finalized=False)
+    assert "FL011" not in _codes(fleetlint.lint_campaign("unbal2"))
+
+
+def test_fl012_obs_context_disagrees_with_journal():
+    import time
+    _fleet_with_run("ctx", epoch_s=time.time(),
+                    context={"campaign": "ctx", "cell": "OTHER",
+                             "worker": "w1"},
+                    events=(_span("jepsen.run", 0.0, 1e6),))
+    assert "FL012" in _error_codes(fleetlint.lint_campaign("ctx"))
+
+
+# ---------------------------------------------------------------------------
+# chaos accounting
+
+
+def _write_coord_trace(cid, fault_kinds):
+    evs = [{"name": "chaos.fault", "ph": "i", "cat": "chaos",
+            "ts": float(i), "pid": 1, "tid": 1,
+            "args": {"kind": k, "fault": "exit-255"}}
+           for i, k in enumerate(fault_kinds)]
+    with open(store.campaign_path(cid, "trace.jsonl"), "w") as f:
+        for ev in evs:
+            f.write(json.dumps(ev) + "\n")
+
+
+def test_fl013_vanished_faults():
+    from jepsen_tpu.fleet import chaos as fchaos
+    prof = fchaos.PROFILES["flaky-exec"].with_seed(7)
+    jr = mk_fleet("vanish", cells=["a"], chaos=prof.describe())
+    leased_terminal(jr, "a")
+    _write_coord_trace("vanish", ["execute", "execute"])
+    diags = fleetlint.lint_campaign("vanish")
+    assert "FL013" in _error_codes(diags)
+    # with the forfeits journaled, the faults are accounted for
+    jr2 = mk_fleet("accounted", cells=["a"], chaos=prof.describe())
+    grant(jr2, "a", attempt=1)
+    forfeit(jr2, "a")
+    grant(jr2, "a", attempt=2)
+    forfeit(jr2, "a")
+    grant(jr2, "a", attempt=3)
+    terminal(jr2, "a", attempt=3)
+    _write_coord_trace("accounted", ["execute", "execute"])
+    assert "FL013" not in _codes(fleetlint.lint_campaign("accounted"))
+
+
+def test_fl013_scheduled_kill_without_a_steal_trail():
+    from jepsen_tpu.fleet import chaos as fchaos
+    prof = fchaos.PROFILES["soak"].with_seed(42)
+    cells = ["a", "b"]
+    (killed,) = prof.plan_kills(cells)
+    jr = mk_fleet("kills", cells=cells,
+                  chaos=dataclasses.asdict(prof))
+    for c in cells:
+        leased_terminal(jr, c)   # one grant each: the kill vanished
+    diags = fleetlint.lint_campaign("kills")
+    hits = [d for d in diags if d.code == "FL013"]
+    assert hits and any(f"cells[{killed}]" in d.location
+                        for d in hits)
+
+
+# ---------------------------------------------------------------------------
+# preflight subset + PL018 resume gate
+
+
+def test_preflight_subset_is_well_formedness_only():
+    jr = mk_fleet("pf", cells=["a"])
+    grant(jr, "a", attempt=1)
+    grant(jr, "a", attempt=2)    # FL007 material: NOT in the subset
+    terminal(jr, "a", attempt=2)
+    assert fleetlint.preflight("pf") == []
+    terminal(jr, "a", attempt=2)          # duplicate terminal IS
+    assert _codes(fleetlint.preflight("pf")) == ["FL001"]
+
+
+def test_pl018_resume_refused_over_corrupt_journal():
+    jr = CampaignJournal("corrupt")
+    jr.write_meta({"status": "aborted", "cells": ["a"]})
+    jr.append_cell({"cell": "a", "outcome": True})
+    jr.append_cell({"cell": "a", "outcome": False})
+    with pytest.raises(scheduler.CampaignError) as ei:
+        scheduler.run_cells([{"id": "a", "test": {}}],
+                            campaign_id="corrupt", resume=True,
+                            run_fn=lambda t: t)
+    assert "PL018" in str(ei.value)
+    assert "cells[a]" in str(ei.value)   # fix-hint names the cell
+
+
+def test_pl018_unknown_fleetlint_knob_refuses_the_fleet():
+    with pytest.raises(dispatch.FleetError) as ei:
+        dispatch.run_fleet([{"id": "a"}],
+                           dispatch.parse_workers("local"),
+                           campaign_id="knob", fleetlint="bogus")
+    assert "PL018" in str(ei.value)
+    # and the journal was never created: refused before any state
+    assert not os.path.exists(store.campaign_path("knob",
+                                                  "cells.jsonl"))
+
+
+def test_pl018_knob_values():
+    assert planlint.lint_fleetlint({"fleetlint": "on"}) == []
+    assert planlint.lint_fleetlint({"fleetlint": "off"}) == []
+    assert planlint.lint_fleetlint({}) == []
+    diags = planlint.lint_fleetlint({"fleetlint": "strict"})
+    assert _codes(diags) == ["PL018"]
+
+
+# ---------------------------------------------------------------------------
+# codelint CL004: the journal single-writer invariant at source level
+
+
+def test_cl004_flags_journal_calls_outside_the_coordinator():
+    src = ("def f(jr, rec):\n"
+           "    jr.append_cell(rec)\n"
+           "    jr.append_event(rec)\n")
+    diags = codelint.lint_source(src, filename="fleet/sync.py",
+                                 journal_calls=True)
+    assert _codes(diags) == ["CL004", "CL004"]
+    # the pragma escapes, statement-line or block-above
+    src_ok = ("def f(jr, rec):\n"
+              "    # replaying a foreign journal on purpose\n"
+              "    # codelint: ok -- test fixture builder\n"
+              "    jr.append_cell(rec)\n"
+              "    jr.append_event(rec)  # codelint: ok\n")
+    assert codelint.lint_source(src_ok, filename="x.py",
+                                journal_calls=True) == []
+    # off by default (direct lint_source callers opt in)
+    assert codelint.lint_source(src, filename="x.py") == []
+
+
+def test_cl004_repo_is_clean_and_coordinators_are_exempt():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        codelint.__file__)))
+    pkg = os.path.join(repo)
+    diags = codelint.lint_paths([pkg], package_root=pkg)
+    cl4 = [d for d in diags if d.code == "CL004"]
+    assert cl4 == [], [str(d) for d in cl4]
+
+
+# ---------------------------------------------------------------------------
+# store: one shared parsed-records read
+
+
+def test_store_folds_accept_preparsed_records():
+    clean_fleet("folds")
+    records = store.load_campaign_records("folds")
+    assert store.latest_campaign_records("folds", records=records) \
+        == store.latest_campaign_records("folds")
+    assert store.campaign_events("folds", records=records) \
+        == store.campaign_events("folds")
+    assert store.fold_latest_records(records) \
+        == store.latest_campaign_records("folds")
+    # fleetlint's model folds from the same single read
+    model = CampaignModel("folds", records=records)
+    assert model.records == records
+    assert model.latest == store.fold_latest_records(records)
+
+
+def test_journal_records_carry_this_process_writer():
+    jr = clean_fleet("stamped")
+    recs = store.load_campaign_records("stamped")
+    assert all(r.get("writer") == jr.writer for r in recs)
+    assert str(os.getpid()) in jr.writer
+
+
+# ---------------------------------------------------------------------------
+# audit artifact: persistence, determinism, containment
+
+
+def test_audit_persists_byte_deterministic_report():
+    clean_fleet("det")
+    report1, _diags = fleetlint.audit("det")
+    p = store.campaign_path("det", fleetlint.ANALYSIS_FILE)
+    assert report1["path"] == p
+    with open(p, "rb") as f:
+        b1 = f.read()
+    report2, _d = fleetlint.audit("det")
+    with open(p, "rb") as f:
+        b2 = f.read()
+    assert b1 == b2
+    loaded = fleetlint.load_report("det")
+    assert loaded["counts"] == report1["counts"]
+    assert loaded["checks"]["records"] == 4
+
+
+def test_audit_unknown_campaign_raises():
+    with pytest.raises(FileNotFoundError):
+        fleetlint.audit("never-existed")
+
+
+def test_web_campaigns_page_shows_audit_verdict():
+    from jepsen_tpu import web
+    jr = clean_fleet("webaudit")
+    terminal(jr, "a")            # corrupt it: FL001
+    fleetlint.audit("webaudit")
+    page = web._campaigns_page()
+    assert "audit:" in page
+    assert "1 error(s)" in page
+    assert "fleet_analysis.json" in page
+    # a clean campaign renders "clean"
+    clean_fleet("webclean")
+    fleetlint.audit("webclean")
+    assert "clean" in web._campaigns_page()
+
+
+class OkClient(jc.Client):
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        return dict(op, type="ok")
+
+
+def quick_cell(name):
+    t = tst.noop_test()
+    t.update(name=name, nodes=["n1"], concurrency=1,
+             client=OkClient(), checker=cc.noop(),
+             generator=gen.clients(
+                 gen.limit(3, gen.repeat({"f": "read"}))))
+    t["ssh"] = {"dummy?": True}
+    t["obs?"] = False
+    return t
+
+
+def test_run_cells_fleetlint_off_skips_gate_and_audit():
+    """The documented escape hatch: --fleetlint off must skip BOTH
+    the resume preflight refusal and the finalize audit on the local
+    scheduler path too."""
+    jr = CampaignJournal("hatch")
+    jr.write_meta({"status": "aborted", "cells": ["a"]})
+    jr.append_cell({"cell": "a", "outcome": True})
+    jr.append_cell({"cell": "a", "outcome": False})   # corrupt
+    report = scheduler.run_cells(
+        [{"id": "a", "test": quick_cell("hatch-a")}],
+        campaign_id="hatch", resume=True, fleetlint=False,
+        run_fn=lambda t: {**t, "results": {"valid": True}})
+    assert report["status"] == "complete"
+    assert "fleet_analysis" not in report
+    assert fleetlint.load_report("hatch") is None
+
+
+def test_containment_audit_crash_never_breaks_the_campaign(
+        monkeypatch):
+    def boom(*a, **kw):
+        raise RuntimeError("auditor bug")
+
+    monkeypatch.setattr(fleetlint, "audit", boom)
+    report = scheduler.run_cells(
+        [{"id": "a", "test": quick_cell("cont-a")}],
+        campaign_id="contained")
+    assert report["status"] == "complete"
+    assert report["summary"]["outcomes"] == {"True": 1}
+    assert "fleet_analysis" not in report
+
+
+def test_containment_audit_errors_never_flip_outcomes_or_exit(
+        monkeypatch):
+    """THE containment acceptance: an audit full of errors is
+    reported, while every cell outcome and the campaign exit code
+    stay exactly what the checkers decided."""
+    real = fleetlint._lint_model
+
+    def with_injected_error(model):
+        diags, checks = real(model)
+        from jepsen_tpu.analysis.diagnostics import diag
+        diags = diags + [diag("FL001", ERROR, "injected", "x")]
+        return diags, checks
+
+    monkeypatch.setattr(fleetlint, "_lint_model", with_injected_error)
+    report = scheduler.run_cells(
+        [{"id": "a", "test": quick_cell("flip-a")}],
+        campaign_id="noflips")
+    assert report["summary"]["outcomes"] == {"True": 1}
+    assert report["status"] == "complete"
+    assert report["fleet_analysis"]["counts"]["error"] >= 1
+    assert cli.campaign_exit_code(report) == 0
+    recs = store.latest_campaign_records("noflips")
+    assert [r["outcome"] for r in recs] == [True]
+
+
+# ---------------------------------------------------------------------------
+# the loopback fleet acceptance: a real campaign audits clean
+
+NOOP_OPTS = {"nodes": ["n1"], "concurrency": 1, "ssh": {"dummy?": True},
+             "time-limit": 1, "workload": "noop"}
+
+
+def test_loopback_fleet_audits_clean_and_deterministic():
+    from jepsen_tpu.campaign import plan
+    cells = plan.expand({"axes": {"seed": [0, 1],
+                                  "workload": ["noop"]}})
+    rep = dispatch.run_fleet(
+        cells, dispatch.parse_workers("local,local"),
+        campaign_id="audited", base_options=NOOP_OPTS, lease_s=120,
+        builder="jepsen_tpu.demo:demo_test")
+    assert rep["status"] == "complete"
+    assert rep["summary"]["outcomes"] == {"True": 2}
+    # the finalize audit ran, found nothing, and reported coverage
+    fa = rep["fleet_analysis"]
+    assert fa["counts"] == {"error": 0, "warning": 0, "info": 0}, fa
+    assert fa["checks"]["runs_audited"] == 2, fa
+    assert fa["checks"]["leases"] >= 2
+    p = store.campaign_path("audited", fleetlint.ANALYSIS_FILE)
+    assert os.path.exists(p)
+    with open(p, "rb") as f:
+        b1 = f.read()
+    # re-auditing the same artifacts is byte-identical
+    fleetlint.audit("audited")
+    with open(p, "rb") as f:
+        b2 = f.read()
+    assert b1 == b2
+    # grant ≺ exec really was checked (run traces were loaded)
+    model = CampaignModel("audited")
+    assert model.mode == "fleet"
+    assert len(model.grants()) >= 2
+    # the journal has exactly one writer: this coordinator
+    writers = {r[0] for r in model.writer_runs()}
+    assert len(writers) == 1

@@ -8,11 +8,18 @@ Usage::
     python tools/lint.py path [path...]  # lint specific files/dirs
     python tools/lint.py --json          # machine-readable diagnostics
     python tools/lint.py --no-ruff       # codelint only
+    python tools/lint.py --campaign [ID] # fleetlint a stored campaign
 
 Exit codes: 0 clean (warnings allowed), 1 error-severity codelint
 diagnostics or ruff violations, 2 internal error. ruff is optional at
 runtime (the container may not ship it); when absent it is skipped
 with a notice -- CI installs it, so the workflow gets both passes.
+
+``--campaign`` switches the driver into the control-plane audit mode:
+instead of linting source, it replays a stored campaign's artifacts
+(``store/campaigns/<ID>/``; default: the most recent campaign)
+through ``analysis.fleetlint``, persists ``fleet_analysis.json``, and
+exits 1 on FL error diagnostics -- the CI chaos-soak oracle.
 """
 
 from __future__ import annotations
@@ -61,6 +68,31 @@ def run_ruff(paths):
     return proc.returncode, (proc.stdout + proc.stderr).strip()
 
 
+def run_campaign_audit(campaign_id, as_json=False):
+    """fleetlint a stored campaign; returns the exit code (0 clean /
+    warnings, 1 FL errors, 2 unknown campaign)."""
+    from jepsen_tpu import store
+    from jepsen_tpu.analysis import fleetlint
+    cid = campaign_id
+    if cid in (None, "", "latest"):
+        cid = store.latest_campaign()
+        if cid is None:
+            print("no campaign found in the store", file=sys.stderr)
+            return 2
+    try:
+        report, diags = fleetlint.audit(cid)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(analysis.render_text(
+            diags, title=f"fleetlint audit: {cid}"))
+        print(f"report: {report.get('path')}")
+    return 1 if analysis.errors(diags) else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=None,
@@ -73,7 +105,16 @@ def main(argv=None):
     ap.add_argument("--package-root", default=None,
                     help="package dir for thread-reachability ranking "
                          "(default: jepsen_tpu when linted)")
+    ap.add_argument("--campaign", nargs="?", const="latest",
+                    default=None, metavar="ID",
+                    help="audit a stored campaign's control-plane "
+                         "artifacts with fleetlint instead of linting "
+                         "source (default ID: the latest campaign); "
+                         "exit 1 on FL errors")
     opts = ap.parse_args(argv)
+
+    if opts.campaign is not None:
+        return run_campaign_audit(opts.campaign, as_json=opts.json)
 
     paths = list(opts.paths) or [os.path.join(REPO, p)
                                  for p in DEFAULT_PATHS

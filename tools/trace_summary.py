@@ -306,7 +306,54 @@ def summarize_campaign(campaign_dir):
         for k, v in fleet.items():
             lines.append(f"{v!s:>12}  {k}")
 
+    # -- control-plane audit (analysis.fleetlint) -----------------------
+    fa = _fleet_audit(campaign_dir)
+    if fa is None:
+        lines.append("\n-- fleetlint audit --\n(no fleet_analysis."
+                     "json and the audit could not run)")
+    else:
+        c = fa.get("counts") or {}
+        checks = fa.get("checks") or {}
+        lines.append("\n-- fleetlint audit --")
+        verdict = "clean" if not c.get("error") else "FAILED"
+        lines.append(
+            f"{verdict}: {c.get('error', 0)} error(s), "
+            f"{c.get('warning', 0)} warning(s), {c.get('info', 0)} "
+            f"info over {checks.get('records', '?')} journal "
+            f"records / {checks.get('runs_audited', '?')} run "
+            "traces")
+        for d in (fa.get("diagnostics") or [])[:8]:
+            loc = f" {d.get('location')}" if d.get("location") else ""
+            lines.append(f"  {str(d.get('severity', '?')).upper()} "
+                         f"{d.get('code')}{loc}: {d.get('message')}")
+
     return "\n".join(lines)
+
+
+def _fleet_audit(campaign_dir):
+    """The campaign's fleetlint report: the persisted
+    fleet_analysis.json when present, else a fresh in-process audit
+    (read-only -- nothing is written), else None."""
+    p = os.path.join(campaign_dir, "fleet_analysis.json")
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        pass
+    try:
+        from jepsen_tpu import store
+        from jepsen_tpu.analysis import fleetlint
+        base = os.path.dirname(os.path.dirname(campaign_dir))
+        cid = os.path.basename(campaign_dir)
+        old = store.base_dir
+        store.base_dir = base
+        try:
+            report, _diags = fleetlint.audit(cid, persist=False)
+        finally:
+            store.base_dir = old
+        return report
+    except Exception:  # noqa: BLE001 - the summary must still print
+        return None
 
 
 def main(argv=None):

@@ -28,6 +28,13 @@ Rungs (BASELINE.md north-star table):
   11. obs overhead: the same fixed-op run with the tracer + crash-safe
       telemetry journals ON vs obs OFF entirely; the fleet telemetry
       plane must cost < 5% of clean-run wall clock
+  12. introspection overhead: the same fixed device WGL search with
+      the search-progress telemetry (per-dispatch progress-tensor
+      device reads, heartbeats, padding accounting, journal flushes)
+      ON vs obs OFF entirely — interleaved OFF/ON pairs, min-of-N
+      quiet-floor estimator (rung 11's methodology); must stay < 5%,
+      with explored-configs and device duty cycle in the detail so
+      the optimization arc restarts from a measured baseline
 
 The baseline is the sequential CPU WGL oracle (our knossos stand-in,
 checker/wgl.py) with a 60 s / config-capped budget per history.
@@ -576,6 +583,105 @@ def _obs_overhead_rung(n_ops=4000, concurrency=8, pairs=6):
         return {"error": repr(exc)}
 
 
+def _introspection_overhead_rung(pairs=5, n_ops=2000):
+    """Device-search introspection overhead (rung 12): the same
+    fixed cas-register device search with the progress telemetry —
+    per-dispatch progress-tensor reads (explored / frontier / depth
+    ride ONE batched device_get), heartbeat trace events, padding
+    accounting, and the crash-safe journal flushes — fully ON
+    (tracer + registry + journals, the plane as shipped) vs obs OFF
+    entirely. OFF/ON runs strictly interleaved with the overhead
+    computed from per-variant MINIMA (rung 11's quiet-floor
+    estimator: hypervisor-steal noise on shared boxes is 2-3x the
+    effect). The detail records the search's explored configs and
+    its device duty cycle (wgl.device_busy_s / measured wall) so the
+    perf trajectory restarts from a measured baseline. Goal: < 5%."""
+    import os
+    import tempfile
+
+    try:
+        from jepsen_tpu import obs
+        from jepsen_tpu.checker import jax_wgl
+        from jepsen_tpu.models import cas_register_spec
+        from jepsen_tpu.simulate import random_history
+
+        hist = random_history(random.Random(1212), "cas-register",
+                              n_procs=16, n_ops=n_ops, crash_p=0.02)
+        e, st = cas_register_spec.encode(hist)
+        # compile outside the timed pairs; 1-iteration dispatch cap so
+        # every run pays one heartbeat-bearing dispatch PER ITERATION
+        # instead of finishing inside one chunk (the overhead under
+        # test is per-dispatch — this is its worst case)
+        kw = {"timeout_s": 120.0, "chunk_iters": 1}
+        jax_wgl.check_encoded(cas_register_spec, e, st, max_configs=1)
+
+        def run_off():
+            # mask the bench's own global registry: OFF means the
+            # engines resolve NO sinks at capture
+            with obs.bind(None, None):
+                t0 = time.perf_counter()
+                r = jax_wgl.check_encoded(cas_register_spec, e, st,
+                                          **kw)
+                return time.perf_counter() - t0, r, None
+
+        def run_on():
+            with tempfile.TemporaryDirectory() as tmp:
+                tr, reg = obs.Tracer(), obs.Registry()
+                tr.attach_journal(os.path.join(
+                    tmp, "trace.jsonl.journal"))
+                reg.attach_journal(os.path.join(
+                    tmp, "metrics.json.journal"))
+                with obs.bind(tr, reg):
+                    t0 = time.perf_counter()
+                    r = jax_wgl.check_encoded(cas_register_spec, e,
+                                              st, **kw)
+                    dt = time.perf_counter() - t0
+                tr.close_journal()
+                reg.close_journal()
+                return dt, r, reg
+
+        off_runs, on_all = [], []
+        run_off()            # warm both code paths once, untimed
+        run_on()
+        for _ in range(pairs):
+            s_off, r_off, _ = run_off()
+            off_runs.append(s_off)
+            on_all.append(run_on())
+        off_s = min(off_runs)
+        # the min-wall ON run is the quiet-floor sample; its OWN
+        # registry supplies the busy wall so the duty cycle pairs
+        # numerator and denominator from the same run
+        on_s, best_on, best_reg = min(on_all, key=lambda t: t[0])
+        on_runs = [t[0] for t in on_all]
+        overhead = (on_s - off_s) / off_s if off_s > 0 else None
+        busy = float(best_reg.counter_value(
+            "wgl.device_busy_s", engine="jax-wgl")) \
+            if best_reg is not None else None
+        return {
+            "n_ops": n_ops, "ops": len(e), "pairs": pairs,
+            "valid": best_on.get("valid") if best_on else None,
+            "explored_configs": best_on.get("configs_explored")
+            if best_on else None,
+            "chunks": int(best_reg.counter_value(
+                "wgl.chunks", engine="jax-wgl"))
+            if best_reg is not None else None,
+            "device_busy_s": round(busy, 4)
+            if busy is not None else None,
+            "duty_cycle": round(busy / on_s, 4)
+            if busy is not None and on_s > 0 else None,
+            "off_s": round(off_s, 4),
+            "off_runs": [round(x, 3) for x in off_runs],
+            "on_s": round(on_s, 4),
+            "on_runs": [round(x, 3) for x in on_runs],
+            "overhead_frac": (round(overhead, 4)
+                              if overhead is not None else None),
+            "goal": "< 0.05",
+            "goal_met": (overhead is not None and overhead < 0.05),
+        }
+    except Exception as exc:  # noqa: BLE001 - numbers, not crashes
+        return {"error": repr(exc)}
+
+
 def _error_headline(msg):
     """The zero-value headline shape every bench failure path emits
     (one definition so error lines can't drift from success lines)."""
@@ -1066,6 +1172,13 @@ def _bench_body(_obs_reg):
     # crash-safe journals) must stay under 5% of clean-run wall clock
     # on the interpreter hot path (pure host work; chip not involved)
     rungs["11-obs-overhead"] = _obs_overhead_rung()
+
+    # introspection-overhead rung: the search-progress telemetry
+    # (progress-tensor device reads + heartbeats + padding accounting
+    # + journal flushes) must stay under 5% of the same search with
+    # obs off, and the detail re-baselines explored-configs and the
+    # device duty cycle for the optimization arc
+    rungs["12-introspection-overhead"] = _introspection_overhead_rung()
 
     # CPU oracles race in parallel subprocesses AFTER all device
     # measurements (their CPU load would pollute the device numbers);

@@ -72,6 +72,18 @@ Codes:
                  second journal writer -- resuming would build on a
                  journal whose folds cannot be trusted), or a
                  bad/unknown --fleetlint knob value
+  PL019 mixed    device introspection: --profile with nowhere
+                 writable to persist the capture (no run name and no
+                 profile-dir, or an unwritable profile-dir), or
+                 --profile with telemetry disabled (obs? False: the
+                 capture's marker and web link anchor to the run's
+                 telemetry artifacts) -- errors; a
+                 progress-interval-s below the heartbeat cadence
+                 (progress is only ever copied off-device once per
+                 host->device dispatch, ~1 s at the fastest, so a
+                 tighter interval buys nothing), or a non-positive /
+                 non-numeric progress-interval-s or profile-max-s
+                 (the default applies instead) -- warnings
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -90,7 +102,8 @@ from .histlint import model_op_set
 logger = logging.getLogger(__name__)
 
 __all__ = ["lint_plan", "lint_campaign", "lint_fleet", "lint_service",
-           "lint_telemetry", "lint_fleetlint", "preflight",
+           "lint_telemetry", "lint_fleetlint", "lint_introspection",
+           "preflight",
            "PlanLintError", "FATAL_CODES", "FLEETLINT_MODES",
            "monitor_diags", "searchplan_diags"]
 
@@ -260,6 +273,93 @@ def lint_plan(test):
     # -- telemetry-plane knobs (jepsen_tpu.obs) ------------------------
     diags += lint_telemetry(
         {"telemetry-flush-ms": test.get("telemetry-flush-ms")})
+
+    # -- device-introspection knobs (obs.search / obs.profile) ---------
+    diags += lint_introspection(test)
+    return diags
+
+
+def lint_introspection(cfg):
+    """The PL019 rules over a test map's (or option map's) device
+    introspection wiring: the ``--profile`` capture knobs and the
+    progress-telemetry cadence. Works on plain option dicts too — the
+    fleet dispatcher runs it over base options."""
+    diags = []
+    if not isinstance(cfg, dict):
+        return diags
+    if cfg.get("profile?"):
+        if cfg.get("obs?") is False:
+            diags.append(diag(
+                "PL019", ERROR,
+                "--profile with telemetry disabled (obs? False): the "
+                "capture's crash-tolerant marker and web link anchor "
+                "to the run's telemetry artifacts, which this run "
+                "will not write",
+                "plan.profile",
+                "drop obs? False, or drop --profile"))
+        pdir = cfg.get("profile-dir")
+        if pdir is not None:
+            import os
+            pdir = str(pdir)
+            parent = os.path.dirname(os.path.abspath(pdir))
+            writable = (os.path.isdir(pdir)
+                        and os.access(pdir, os.W_OK)) \
+                or (not os.path.exists(pdir)
+                    and os.path.isdir(parent)
+                    and os.access(parent, os.W_OK))
+            if not writable:
+                diags.append(diag(
+                    "PL019", ERROR,
+                    f"profile-dir {pdir!r} is not a writable "
+                    "directory (and cannot be created): the XLA "
+                    "capture has nowhere to land",
+                    "plan.profile-dir",
+                    "point profile-dir at a writable location, or "
+                    "drop it to use the run directory"))
+        elif not cfg.get("name") and ("checker" in cfg
+                                      or "client" in cfg):
+            # only a REAL test map can be "unnamed": plain option
+            # maps (campaign --lint, run_fleet base options) name
+            # their cells at build time, so the check skips there
+            diags.append(diag(
+                "PL019", ERROR,
+                "--profile on an unnamed test with no profile-dir: "
+                "there is no run directory to persist the capture "
+                "next to trace.jsonl",
+                "plan.profile",
+                "name the test or pass profile-dir"))
+        pm = cfg.get("profile-max-s")
+        if pm is not None and (not isinstance(pm, (int, float))
+                               or isinstance(pm, bool) or pm <= 0):
+            diags.append(diag(
+                "PL019", WARNING,
+                f"profile-max-s should be a positive number, got "
+                f"{pm!r}: the default capture bound applies instead",
+                "plan.profile-max-s"))
+    pi = cfg.get("progress-interval-s")
+    if pi is not None:
+        if not isinstance(pi, (int, float)) or isinstance(pi, bool) \
+                or pi <= 0:
+            diags.append(diag(
+                "PL019", WARNING,
+                f"progress-interval-s should be a positive number, "
+                f"got {pi!r}: progress telemetry keeps its "
+                "per-dispatch default cadence",
+                "plan.progress-interval-s"))
+        else:
+            from ..obs.search import HEARTBEAT_MIN_INTERVAL_S
+            if pi < HEARTBEAT_MIN_INTERVAL_S:
+                diags.append(diag(
+                    "PL019", WARNING,
+                    f"progress-interval-s {pi:g} is below the "
+                    "heartbeat cadence "
+                    f"({HEARTBEAT_MIN_INTERVAL_S:g} s): progress is "
+                    "copied off-device at most once per host->device "
+                    "dispatch, so a tighter interval cannot make the "
+                    "telemetry any fresher",
+                    "plan.progress-interval-s",
+                    "drop the knob for per-dispatch cadence, or "
+                    "raise it to thin the trace"))
     return diags
 
 

@@ -273,23 +273,30 @@ class Linearizable(Checker):
             first_done.set()
 
         # the CPU engines get config budgets so they yield on hard
-        # searches; knossos.competition likewise races linear + wgl
+        # searches; knossos.competition likewise races linear + wgl.
+        # Each racer runs under a contextvars snapshot (like the
+        # interpreter/control fan-outs) so the run-scoped obs sinks —
+        # and span parentage — follow it: the device engine's
+        # heartbeats must land in THIS run's registry even while an
+        # overlapping campaign cell holds the process-global binding.
+        import contextvars
         cancel = threading.Event()
         threads = [
             threading.Thread(
-                target=run, args=("wgl", lambda: wgl.check_encoded(
+                target=contextvars.copy_context().run,
+                args=(run, "wgl", lambda: wgl.check_encoded(
                     self.spec, e, init_state, max_configs=2_000_000,
                     cancel=cancel)),
                 daemon=True),
             threading.Thread(
-                target=run,
-                args=("linear", lambda: linear.check_encoded(
+                target=contextvars.copy_context().run,
+                args=(run, "linear", lambda: linear.check_encoded(
                     self.spec, e, init_state, max_configs=200_000,
                     cancel=cancel)),
                 daemon=True),
             threading.Thread(
-                target=run,
-                args=("jax-wgl", lambda: jax_wgl.check_encoded(
+                target=contextvars.copy_context().run,
+                args=(run, "jax-wgl", lambda: jax_wgl.check_encoded(
                     self.spec, e, init_state, cancel=cancel,
                     **self.engine_opts)),
                 daemon=True),

@@ -1227,6 +1227,10 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     # sinks captured ONCE at search start: a competition-abandoned
     # straggler must not write into a later run's artifacts
     so = obs_search.capture()
+    # padding accounting: one real history of len(e) rows rides an
+    # n_pad-row padded plan (power-of-two bucket for compile reuse);
+    # the per-bucket real/padded counters feed the waste table
+    so.plan("jax-wgl", n_pad, len(e), n_pad)
     it = int(carry[IDX_IT][0])
     # Adaptive dispatch quantum. ``chunk_iters`` is the CAP (explicit
     # tiny values are a cadence contract the checkpoint tests rely
@@ -1247,21 +1251,27 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
         t_chunk = _time.monotonic()
         bound = min(it + eff, max_iters)
         carry = run_chunk(carry, *consts, jnp.int32(bound))
-        # ONE host round-trip for all four scalars (separate device_gets
-        # cost ~0.2 s each over the remote-TPU tunnel; see table_stats)
-        status, top, it, explored = (
-            int(x) for x in jax.device_get(
-                (carry[IDX_STATUS][0], carry[IDX_TOP][0],
-                 carry[IDX_IT][0], carry[IDX_EXPLORED][0])))
+        # ONE host round-trip for the whole progress tensor (separate
+        # device_gets cost ~0.2 s each over the remote-TPU tunnel; see
+        # table_stats): status/top/it/explored scalars plus the TOPK
+        # witness depths, whose max is the deepest linearized-ok count
+        # reached — the search's progress toward n_ok
+        status, top, it, explored, bdepth = jax.device_get(
+            (carry[IDX_STATUS][0], carry[IDX_TOP][0],
+             carry[IDX_IT][0], carry[IDX_EXPLORED][0],
+             carry[IDX_BEST_DEPTH][0]))
+        status, top, it, explored = (int(status), int(top), int(it),
+                                     int(explored))
         # heartbeat per dispatch: long searches stop being a silent jit
-        # black box (frontier depth + cumulative explored, streamed to
-        # the captured tracer/registry; no-op when obs is unbound, and
-        # no extra device reads either way — the scalars ride the
-        # batched device_get above)
+        # black box (frontier depth + cumulative explored + deepest op
+        # reached, streamed to the captured tracer/registry; no-op when
+        # obs is unbound, and no extra device round-trips either way —
+        # everything rides the batched device_get above)
         so.heartbeat(
             "jax-wgl", iteration=it,
             chunk_s=_time.monotonic() - t_chunk, frontier=top,
-            explored=explored)
+            explored=explored,
+            depth=max(0, int(np.asarray(bdepth).max())))
         if status != RUNNING or top == 0 or it >= max_iters:
             break
         now = _time.monotonic()

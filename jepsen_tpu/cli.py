@@ -102,6 +102,28 @@ def add_test_opts(parser):
                              "planner applies (default: "
                              "per-key,crash-segments; planlint PL015 "
                              "rejects unknown names).")
+    parser.add_argument("--profile", action="store_true",
+                        help="Capture an XLA profiler trace around the "
+                             "run's device searches, persisted next to "
+                             "trace.jsonl (bounded by --profile-max-s; "
+                             "contained: a run whose profiler is "
+                             "unavailable proceeds unprofiled).")
+    parser.add_argument("--profile-dir", default=None, metavar="DIR",
+                        help="Where the XLA capture lands (default: "
+                             "<run dir>/profile; PL019 rejects "
+                             "unwritable locations).")
+    parser.add_argument("--profile-max-s", type=float, default=None,
+                        metavar="SECONDS",
+                        help="Capture wall bound: the profiler stops "
+                             "after this even if the search is still "
+                             "running (default 120).")
+    parser.add_argument("--progress-interval-s", type=float,
+                        default=None, metavar="SECONDS",
+                        help="Minimum interval between search-progress "
+                             "trace emissions / journal flushes "
+                             "(default: every host->device dispatch; "
+                             "PL019 warns below the ~1 s heartbeat "
+                             "cadence).")
     parser.add_argument("--lint", action="store_true",
                         help="Dry run: statically validate the test plan "
                              "(planlint) and exit without contacting any "
@@ -172,6 +194,17 @@ def test_opt_fn(opts):
         opts["monitor"] = {"chunk": chunk} if chunk is not None else True
     elif chunk is not None:
         opts["monitor-chunk"] = chunk
+    # device introspection (jepsen_tpu.obs.profile / obs.search):
+    # --profile maps onto the profile? key core.analyze watches;
+    # the dir/bound/cadence knobs pass through under their test names
+    if opts.pop("profile", False):
+        opts["profile?"] = True
+    for flag, key in (("profile-dir", "profile-dir"),
+                      ("profile-max-s", "profile-max-s"),
+                      ("progress-interval-s", "progress-interval-s")):
+        v = opts.pop(flag, None)
+        if v is not None:
+            opts[key] = v
     # search planner (jepsen_tpu.analysis.searchplan): planning is on
     # by default, so only an explicit opt-out / predicate list lands
     # on the map (PL015 warns on explicit-enable without a plannable
@@ -682,6 +715,9 @@ def campaign_cmd(opts):
         # searchplan knob preflight (PL015) rides along over the base
         # options every cell is built from, mirroring run_fleet
         diags += analysis.planlint.searchplan_diags(options)
+        # device-introspection knob preflight (PL019) rides the same
+        # way: profile / progress-cadence mistakes surface at --lint
+        diags += analysis.planlint.lint_introspection(options)
         # fleetlint knob preflight (PL018, knob half) rides the same
         # way; the journal half runs inside run_fleet's resume path
         diags += analysis.planlint.lint_fleetlint(

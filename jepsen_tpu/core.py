@@ -300,7 +300,12 @@ def analyze(test):
     mv = test.get("monitor-verdict")
     skip = bool(mv and mv.get("verdict") in (True, False)
                 and (jmonitor.config(test) or {}).get("skip-offline?"))
-    with obs.span("analyze"):
+    # --profile: wrap the analyze phase — the run's device searches —
+    # in XLA profiler capture (obs/profile.py: bounded, opt-in,
+    # contained; the capture lands next to trace.jsonl and a run whose
+    # profiler is unavailable proceeds unprofiled)
+    from .obs import profile as obs_profile
+    with obs_profile.scope(test), obs.span("analyze"):
         test["history"] = jhistory.index(test.get("history") or [])
         if skip:
             # monitor-verdict handoff: the run opted out of the offline

@@ -281,7 +281,9 @@ def demo_test(options):
     # flags previously never reached the demo test map at all)
     for k in ("op-timeout-ms", "time-limit-s", "abort-grace-s",
               "monitor", "monitor-chunk", "searchplan?",
-              "searchplan-partitions", "searchplan-min-segment"):
+              "searchplan-partitions", "searchplan-min-segment",
+              "profile?", "profile-dir", "profile-max-s",
+              "progress-interval-s", "telemetry-flush-ms"):
         if options.get(k) is not None:
             test[k] = options[k]
     if name == "bank":

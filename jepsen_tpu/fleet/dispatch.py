@@ -244,6 +244,10 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     # (unknown predicate names, carry disabled under the monitor)
     # surface before any host is contacted
     diags += planlint.searchplan_diags(base_options)
+    # PL019 rides along over the base options like PL015: profile /
+    # progress-cadence knob mistakes surface before any host is
+    # contacted (workers rebuild test maps from these options)
+    diags += planlint.lint_introspection(base_options)
     # PL018 (knob half): an unknown --fleetlint value is an error
     # here, not a silently-skipped audit
     diags += planlint.lint_fleetlint({"fleetlint": fleetlint})
@@ -386,15 +390,26 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
 
     folded_cells = set()
 
+    #: per-cell wgl counter families folded into the live fleet
+    #: registry as cells finish, so GET /api/metrics serves the
+    #: campaign's search-progress and padding accounting MID-RUN
+    #: (bucket/engine labels survive: the flat key's label suffix is
+    #: kept verbatim on the cell-labelled re-emission)
+    _WGL_LIVE_COUNTERS = ("wgl.states_explored_total",
+                          "wgl.cells_real", "wgl.cells_padded",
+                          "wgl.device_busy_s", "wgl.chunks")
+
     def _fold_worker_metrics(rec):
-        """Fold the headline gauges out of a finished cell's own
-        metrics artifact (monitor detection latency + violations) into
+        """Fold the headline series out of a finished cell's own
+        metrics artifact (monitor detection latency + violations, and
+        the device-search introspection counters: explored configs,
+        real/padded batch rows per n-bucket, device-busy wall) into
         the live fleet registry, so ``GET /api/metrics`` serves them
         while the campaign is still running. Best effort: the file is
         local only for shared-store/synced cells. Folded at most ONCE
         per cell — a forfeited-sync re-run would otherwise re-inc the
-        violation counter per attempt (detection latency is safe via
-        max_gauge, the counter is not)."""
+        counters per attempt (detection latency is safe via
+        max_gauge, the counters are not)."""
         try:
             if rec.get("cell") in folded_cells:
                 return
@@ -410,9 +425,17 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                 if k.startswith("monitor.detection_latency_s"):
                     reg.max_gauge("monitor.detection_latency_s",
                                   float(v), cell=cid)
+            from ..obs.metrics import parse_flat_key
             for k, v in (m.get("counters") or {}).items():
                 if k.startswith("monitor.violations"):
                     reg.inc("monitor.violations", int(v), cell=cid)
+                    continue
+                name, raw = parse_flat_key(k)
+                if name in _WGL_LIVE_COUNTERS:
+                    labels = {"cell": cid,
+                              **{lk: lv for lk, lv in raw.items()
+                                 if lk in ("engine", "bucket")}}
+                    reg.inc(name, v, **labels)
         except Exception:  # noqa: BLE001 - telemetry fold only
             logger.warning("couldn't fold worker metrics",
                            exc_info=True)
@@ -934,6 +957,20 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
             except Exception:  # noqa: BLE001
                 logger.warning("couldn't merge the campaign trace",
                                exc_info=True)
+        # fold the per-cell metrics (journal fallback included) into
+        # metrics_fold.json and surface the introspection headline —
+        # per-bucket padding waste + device-busy wall — on the report.
+        # Contained: a fold failure costs the table, never the campaign
+        try:
+            from ..obs import merge as obs_merge
+            fold = obs_merge.fold_campaign_metrics(campaign_id)
+            report["introspection"] = obs_merge.introspection_summary(
+                fold)
+            report["introspection"]["metrics_fold"] = fold.get("path")
+            jr.write_report(report)
+        except Exception:  # noqa: BLE001
+            logger.warning("couldn't fold campaign metrics",
+                           exc_info=True)
         jr.write_meta({**(jr.load_meta() or {}),
                        "status": "aborted" if aborted else "complete",
                        "updated": store.local_time()})

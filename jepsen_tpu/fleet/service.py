@@ -52,7 +52,8 @@ __all__ = ["MAX_BODY_BYTES", "ApiError", "Admission",
            "check_history", "submit_campaign", "campaign_status",
            "latch", "drain", "shutdown", "reset",
            "register_metrics_source", "unregister_metrics_source",
-           "metrics_text"]
+           "metrics_text", "slo_registry", "note_request",
+           "endpoint_of"]
 
 #: request-body ceiling enforced by web.Handler BEFORE reading
 MAX_BODY_BYTES = 16 << 20
@@ -322,6 +323,78 @@ _lock = threading.Lock()
 _latch = None
 _admission = None
 _campaigns = {}     # campaign id -> {"thread", "latch", "submitted"}
+_slo = None
+
+
+# ---------------------------------------------------------------------------
+# service SLO metrics: per-endpoint request accounting + the
+# verdict-latency / queue-wait histograms the batch-coalescing work is
+# gated on (p50/p99 derive from the Prometheus buckets)
+
+#: request/verdict latency buckets, seconds: /api/check spans sub-ms
+#: histlint rejections to the 120 s engine cap
+SLO_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+def slo_registry():
+    """The service's own metrics registry (lives independent of any
+    run's bound registry: a serve-only process still has SLOs).
+    Rendered into ``GET /api/metrics`` alongside everything else."""
+    global _slo
+    with _lock:
+        if _slo is None:
+            from ..obs import Registry
+            _slo = Registry()
+        return _slo
+
+
+def endpoint_of(path):
+    """The SLO label for one /api path: 'check', 'campaigns',
+    'campaign-status', 'metrics', or 'other'."""
+    clean = str(path).rstrip("/")
+    if clean == "/api/check":
+        return "check"
+    if clean == "/api/campaigns":
+        return "campaigns"
+    if clean.startswith("/api/campaigns/"):
+        return "campaign-status"
+    if clean == "/api/metrics":
+        return "metrics"
+    return "other"
+
+
+def note_request(endpoint, status, wall_s):
+    """One served /api request: counted per {endpoint, status} with a
+    request-latency observation, plus a trace span when a tracer is
+    bound (fleet coordinators bind one, so request handling lands on
+    the campaign timeline). web.Handler calls this for every /api
+    response, including the 4xx/5xx ones."""
+    try:
+        reg = slo_registry()
+        reg.inc("service.requests", endpoint=str(endpoint),
+                status=str(int(status)))
+        reg.observe("service.request_s", float(wall_s),
+                    buckets=SLO_BUCKETS_S, endpoint=str(endpoint))
+        from .. import obs
+        tr = obs.tracer()
+        if tr is not None:
+            now = tr.now_ns()
+            dur = int(float(wall_s) * 1e9)
+            tr.complete("service.request", now - dur, dur,
+                        cat="service",
+                        args={"endpoint": str(endpoint),
+                              "status": int(status)})
+    except Exception:  # noqa: BLE001 - accounting must not 500 requests
+        logger.warning("request accounting failed", exc_info=True)
+
+
+def _slo_observe(name, value, **labels):
+    try:
+        slo_registry().observe(name, float(value),
+                               buckets=SLO_BUCKETS_S, **labels)
+    except Exception:  # noqa: BLE001
+        logger.warning("SLO observation failed", exc_info=True)
 
 
 def configure(token=None, tokens=None, budgets=None,
@@ -386,10 +459,11 @@ def shutdown(reason="service-shutdown", join_s=10.0):
 
 def reset():
     """Forget service state (tests)."""
-    global _latch, _admission
+    global _latch, _admission, _slo
     with _lock:
         _latch = None
         _admission = None
+        _slo = None
         _campaigns.clear()
         _metrics_sources.clear()
 
@@ -435,19 +509,20 @@ def _ledger_section():
 
 
 def metrics_text():
-    """The ``GET /api/metrics`` body: the bound obs Registry (the
-    in-process run/campaign, when one is live), every registered
-    source (fleet dispatch gauges), the admission gate's live state,
-    and the compile-ledger aggregate — rendered in the Prometheus
-    text exposition format. Sources that fail are skipped, never
-    5xx'd: a metrics scrape must not depend on every subsystem being
-    healthy (that is what it is for)."""
+    """The ``GET /api/metrics`` body: EVERY live obs Registry (each
+    in-process run/campaign with an open bind scope — concurrent
+    campaign cells expose distinct {campaign, cell}-labelled series,
+    including the device searches' live explored/frontier progress
+    gauges mid-search), every registered source (fleet dispatch
+    gauges), the service's own SLO registry (per-endpoint request
+    counts, verdict-latency and queue-wait histograms), the admission
+    gate's live state, and the compile-ledger aggregate — rendered in
+    the Prometheus text exposition format. Sources that fail are
+    skipped, never 5xx'd: a metrics scrape must not depend on every
+    subsystem being healthy (that is what it is for)."""
     from .. import obs
 
-    sections = []
-    reg = obs.registry()
-    if reg is not None:
-        sections.append(reg)
+    sections = list(obs.live_registries())
     with _lock:
         sources = list(_metrics_sources.items())
     for name, fn in sources:
@@ -464,6 +539,7 @@ def metrics_text():
     sections.append({"gauges": adm.gauges(),
                      "counters": {"admission.shed_total":
                                   adm.shed_count}})
+    sections.append(slo_registry())
     try:
         led = _ledger_section()
         if led is not None:
@@ -523,9 +599,19 @@ def check_history(payload, caller="local"):
                             f"service accepts at most {MAX_CHECK_OPS}")
     # admission: one concurrent-check slot per caller for the whole
     # pipeline (the check is NP-hard; accepted events ARE the cost, so
-    # the history length is what the daily quota charges)
+    # the history length is what the daily quota charges). SLO
+    # accounting brackets it: queue wait is the slot-acquisition wall
+    # (the signal the batch-coalescing work needs — queued strangers
+    # are the coalescing opportunity), verdict latency the whole
+    # admission-to-verdict request wall
+    t0 = time.monotonic()
     with admission().check_slot(caller, ops=len(hist)):
-        return _check_admitted(payload, hist)
+        _slo_observe("service.queue_wait_s", time.monotonic() - t0,
+                     endpoint="check")
+        out = _check_admitted(payload, hist)
+    _slo_observe("service.verdict_latency_s", time.monotonic() - t0,
+                 endpoint="check", valid=str(out.get("valid")))
+    return out
 
 
 def _check_admitted(payload, hist):

@@ -139,11 +139,12 @@ class Monitor:
         self.quiescent_carry = bool(quiescent_carry)
         self.truncated_ops = 0
         self.violation = None
-        # sinks captured at construction (inside the run's obs scope):
-        # overlapping campaign cells must not cross-attribute monitor
-        # telemetry through the process-global binding
-        self._tr = obs.tracer()
-        self._reg = obs.registry()
+        # sinks captured at construction through the RUN-SCOPED
+        # resolution (install runs on the run's own thread inside
+        # obs.run_scope): overlapping campaign cells must not
+        # cross-attribute monitor telemetry through the
+        # last-binder-wins process-global binding
+        self._tr, self._reg = obs.current_sinks()
         self._cancel = threading.Event()
         self._cond = threading.Condition()
         self._queue = collections.deque()   # (op, index, t_offer)
@@ -400,8 +401,14 @@ class Monitor:
             self._check_key(key, dirty[key])
 
     def _run(self):
-        with self._span("monitor.run", engine=self.engine,
-                        chunk=self.chunk):
+        # the monitor thread starts with an empty contextvars context;
+        # pin the pair captured at construction as the run-scoped
+        # sinks so the device checks it drives (and their search
+        # heartbeats) land in THIS run's series, not whichever
+        # overlapping cell holds the process-global binding
+        with obs.sink_scope(self._tr, self._reg), \
+                self._span("monitor.run", engine=self.engine,
+                           chunk=self.chunk):
             while True:
                 with self._cond:
                     while (self._pending_completions < self.chunk

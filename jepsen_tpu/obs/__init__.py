@@ -27,6 +27,7 @@ store.py persists both artifacts next to ``results.json``.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import threading
 import time as _time
 
@@ -36,7 +37,9 @@ from .trace import Tracer, current_span, load_trace, trace_meta
 
 __all__ = [
     "Tracer", "Registry", "Histogram", "DEFAULT_LATENCY_BUCKETS_S",
-    "bind", "run_scope", "tracer", "registry", "enabled", "current_span",
+    "bind", "run_scope", "sink_scope", "tracer", "registry",
+    "current_sinks", "run_config", "live_registries", "enabled",
+    "current_span",
     "load_trace", "trace_meta", "load_metrics_journal",
     "render_prometheus", "span", "instant", "complete", "counter_track",
     "window_start", "window_end", "name_thread", "now_ns", "inc",
@@ -53,6 +56,18 @@ _registry = None
 #: without severing a live sibling or leaking a dead pair.
 _bind_stack = []
 
+#: run-scoped sinks: (tracer, registry, config) for the RUN this
+#: logical context belongs to. The global pair above is
+#: last-binder-wins, so with OVERLAPPING campaign cells a device
+#: search that read the globals could capture a SIBLING cell's sinks
+#: and fold its heartbeat counters into the wrong {campaign, cell}
+#: series. The contextvar rides the `contextvars.copy_context()`
+#: snapshots the thread fan-outs already take, so code on a run's own
+#: threads resolves the run's own pair; threads outside any run fall
+#: back to the globals.
+_ctx_sinks = contextvars.ContextVar("jepsen_obs_run_sinks",
+                                    default=None)
+
 
 def tracer():
     """The active Tracer, or None."""
@@ -62,6 +77,54 @@ def tracer():
 def registry():
     """The active Registry, or None."""
     return _registry
+
+
+def current_sinks():
+    """(tracer, registry) for THIS logical context: the run-scoped
+    pair when inside a run (correct even while a sibling campaign
+    cell holds the process-global binding), else the globals. The
+    device search sessions (obs.search.capture) resolve through this
+    so two concurrent cells' heartbeat counters stop folding into one
+    series."""
+    ctx = _ctx_sinks.get()
+    if ctx is not None:
+        return ctx[0], ctx[1]
+    return _tracer, _registry
+
+
+def run_config():
+    """The run-scoped obs config mapping (progress-interval-s, ...),
+    or {} outside any run scope."""
+    ctx = _ctx_sinks.get()
+    return ctx[2] if ctx is not None and ctx[2] else {}
+
+
+@contextlib.contextmanager
+def sink_scope(tr, reg, config=None):
+    """Pin (tracer, registry) as THIS context's run-scoped sinks
+    without touching the process-global binding — how a thread that
+    captured its run's pair at construction (the monitor) makes the
+    search sessions it drives resolve that pair instead of whatever
+    the globals currently say."""
+    token = _ctx_sinks.set((tr, reg, dict(config or {})))
+    try:
+        yield (tr, reg)
+    finally:
+        _ctx_sinks.reset(token)
+
+
+def live_registries():
+    """Every registry with an open bind() scope, oldest first,
+    deduped. /api/metrics renders ALL of them (each run's registry
+    carries its own {campaign, cell} default labels, so concurrent
+    cells expose distinct series), not just the newest binder's."""
+    with _lock:
+        pairs = list(_bind_stack)
+    out = []
+    for _tr, reg in pairs:
+        if reg is not None and all(reg is not r for r in out):
+            out.append(reg)
+    return out
 
 
 def enabled():
@@ -109,7 +172,13 @@ def run_scope(test):
     worker: ``{campaign, cell, worker}``) becomes the tracer's
     trace_meta context AND the registry's default labels, so every
     span and metric the run emits stays attributable after the
-    campaign-level merge."""
+    campaign-level merge.
+
+    The pair is ALSO pinned as this context's run-scoped sinks
+    (`sink_scope`), so the run's own threads — checker competition
+    racers, the device search host loops — resolve this run's pair
+    through `current_sinks` even while an overlapping sibling cell
+    holds the process-global binding."""
     if not test.get("obs?", True):
         test.pop("obs", None)
         return contextlib.nullcontext((None, None))
@@ -117,7 +186,16 @@ def run_scope(test):
     tr = Tracer(context=ctx)
     reg = Registry(default_labels=ctx)
     test["obs"] = {"tracer": tr, "registry": reg}
-    return bind(tr, reg)
+    cfg = {k: test[k] for k in ("progress-interval-s",)
+           if test.get(k) is not None}
+
+    @contextlib.contextmanager
+    def scope():
+        with bind(tr, reg):
+            with sink_scope(tr, reg, cfg):
+                yield (tr, reg)
+
+    return scope()
 
 
 # ---------------------------------------------------------------------------

@@ -59,10 +59,12 @@ from .trace import load_trace, trace_meta
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["MERGED_TRACE_FILE", "worker_offsets", "clock_offset",
-           "merge_campaign"]
+__all__ = ["MERGED_TRACE_FILE", "FOLDED_METRICS_FILE",
+           "worker_offsets", "clock_offset", "merge_campaign",
+           "fold_campaign_metrics", "introspection_summary"]
 
 MERGED_TRACE_FILE = "campaign_trace.jsonl"
+FOLDED_METRICS_FILE = "metrics_fold.json"
 
 
 def clock_offset(clock):
@@ -219,3 +221,145 @@ def merge_campaign(campaign_id, out_path=None):
                             "offset_s": round(offsets.get(w, 0.0), 6)}
                         for w in workers},
             "status": (meta or {}).get("status")}
+
+
+# ---------------------------------------------------------------------------
+# campaign metrics fold: per-run metrics.json -> one campaign snapshot
+
+def _fold_histogram(acc, h):
+    """Merge one histogram dict into the accumulator (same on-disk
+    shape as obs.metrics.Histogram.to_dict). Different bucket bounds
+    (a knob changed between cells) keep the first cell's bounds and
+    fold sum/count only — counts from mismatched bounds would lie."""
+    if acc is None:
+        return {k: (list(v) if isinstance(v, list) else v)
+                for k, v in h.items()}
+    if list(acc.get("buckets_le") or []) == list(h.get("buckets_le")
+                                                 or []):
+        acc["counts"] = [a + b for a, b in
+                         zip(acc.get("counts") or [],
+                             h.get("counts") or [])]
+    acc["sum"] = (acc.get("sum") or 0.0) + (h.get("sum") or 0.0)
+    acc["count"] = (acc.get("count") or 0) + (h.get("count") or 0)
+    for k, pick in (("min", min), ("max", max)):
+        vals = [v for v in (acc.get(k), h.get(k)) if v is not None]
+        acc[k] = pick(vals) if vals else None
+    return acc
+
+
+def fold_campaign_metrics(campaign_id, persist=True):
+    """Fold the coordinator's and every cell run's metrics snapshots
+    into ONE campaign-level view: counters sum, numeric gauges keep
+    their max (they are occupancy/high-water series), histograms
+    merge. Snapshots come through ``store.load_run_metrics`` (journal
+    fallback included, so kill -9'd cells still contribute). With
+    ``persist`` the fold lands as deterministic sorted-key
+    ``store/campaigns/<id>/metrics_fold.json``.
+
+    This is what turns the per-cell padding/duty-cycle accounting
+    (``wgl.cells_real``/``wgl.cells_padded`` per n-bucket,
+    ``wgl.device_busy_s``) into the campaign's waste table — each
+    cell's series carry their {campaign, cell, worker} default
+    labels, so the summed fold stays attributable AND aggregable.
+
+    The coordinator's own snapshot is folded WITHOUT its
+    cell-labelled series: those are the dispatcher's live per-cell
+    re-folds (``_fold_worker_metrics``) of the very run metrics this
+    fold reads directly — summing both would double every re-folded
+    counter."""
+    from .metrics import parse_flat_key
+    from .. import store
+
+    counters, gauges, hists = {}, {}, {}
+    records = store.latest_campaign_records(campaign_id)
+    dirs = [(store.campaign_path(campaign_id), True)]
+    seen = set()
+    for rec in records:
+        p = rec.get("path")
+        if p and os.path.isdir(str(p)) and str(p) not in seen:
+            seen.add(str(p))
+            dirs.append((str(p), False))
+    runs_folded = 0
+    for d, coordinator in dirs:
+        m = store.load_run_metrics(d)
+        if not isinstance(m, dict):
+            continue
+        runs_folded += 1
+
+        def relevant(k):
+            return not (coordinator
+                        and "cell" in parse_flat_key(k)[1])
+
+        for k, v in (m.get("counters") or {}).items():
+            if not relevant(k):
+                continue
+            try:
+                counters[k] = counters.get(k, 0) + v
+            except TypeError:
+                continue
+        for k, v in (m.get("gauges") or {}).items():
+            if not relevant(k):
+                continue
+            try:
+                gauges[k] = v if k not in gauges \
+                    else max(gauges[k], v)
+            except TypeError:
+                gauges.setdefault(k, v)
+        for k, h in (m.get("histograms") or {}).items():
+            if isinstance(h, dict) and relevant(k):
+                hists[k] = _fold_histogram(hists.get(k), h)
+    fold = {"campaign": str(campaign_id), "runs_folded": runs_folded,
+            "counters": dict(sorted(counters.items())),
+            "gauges": dict(sorted(gauges.items())),
+            "histograms": dict(sorted(hists.items()))}
+    if persist:
+        out = store.campaign_path(campaign_id, FOLDED_METRICS_FILE)
+        d = os.path.dirname(out)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(fold, f, indent=1, sort_keys=True, default=str)
+            f.write("\n")
+        os.replace(tmp, out)
+        fold["path"] = out
+    return fold
+
+
+def introspection_summary(fold, makespan_s=None):
+    """The device-introspection headline from a metrics fold (or any
+    snapshot dict): the per-bucket padding-waste table and the device
+    duty cycle.
+
+    * ``padding``: {bucket: {real, padded, waste_frac}} summed over
+      engines — how many padded batch rows per power-of-two n-bucket
+      were real ops vs inert lanes.
+    * ``device_busy_s``: summed per engine; ``duty_cycle`` = total
+      busy wall / ``makespan_s`` when the caller knows the campaign
+      makespan (the trace summary does)."""
+    from .metrics import parse_flat_key
+    counters = (fold or {}).get("counters") or {}
+    buckets = {}
+    busy = {}
+    for k, v in counters.items():
+        name, labels = parse_flat_key(k)
+        if name in ("wgl.cells_real", "wgl.cells_padded"):
+            b = labels.get("bucket") or "?"
+            st = buckets.setdefault(b, {"real": 0, "padded": 0})
+            st["real" if name.endswith("real") else "padded"] += int(v)
+        elif name == "wgl.device_busy_s":
+            eng = labels.get("engine") or "?"
+            busy[eng] = busy.get(eng, 0.0) + float(v)
+    for st in buckets.values():
+        total = st["real"] + st["padded"]
+        st["waste_frac"] = round(st["padded"] / total, 4) if total \
+            else 0.0
+    out = {"padding": {b: buckets[b] for b in
+                       sorted(buckets, key=lambda x:
+                              int(x) if str(x).isdigit() else 0)},
+           "device_busy_s": {e: round(s, 3)
+                             for e, s in sorted(busy.items())},
+           "device_busy_total_s": round(sum(busy.values()), 3)}
+    if makespan_s and makespan_s > 0:
+        out["duty_cycle"] = round(sum(busy.values()) / makespan_s, 4)
+    return out

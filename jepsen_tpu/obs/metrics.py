@@ -108,6 +108,22 @@ def _key(name, labels):
         (str(k), str(v)) for k, v in (labels or {}).items())))
 
 
+def parse_flat_key(key):
+    """``name{k=v,...}`` -> (name, {k: v}) — the inverse of the
+    snapshot()/metrics.json flattened keys, shared by every consumer
+    (web's utilization table, the campaign metrics fold, the fleet
+    dispatcher's live re-fold). Best effort: label VALUES containing
+    ``=``/``,`` parse wrong, which costs one folded cell, not data."""
+    if "{" not in key:
+        return key, {}
+    name, _, rest = key.partition("{")
+    labels = {}
+    for part in rest.rstrip("}").split(","):
+        k, _, v = part.partition("=")
+        labels[k] = v
+    return name, labels
+
+
 class Registry:
     """Thread-safe home for counters, gauges, and histograms.
 

@@ -7,21 +7,31 @@ the three host loops — the single-key search (checker/jax_wgl.py), the
 multi-key batch (parallel/keyshard.py), and the mesh-sharded single
 search (parallel/searchshard.py) — one cheap call per dispatch:
 
-* `heartbeat()` emits an instant trace event + counter tracks (frontier
-  depth, states explored, keys still running, shard balance) and
-  updates gauges, so a stalled search is diagnosable mid-flight from
-  trace.jsonl;
+* `plan()` records, once per search, the padded batch's composition —
+  real vs padding rows per power-of-two n-bucket — so per-bucket
+  padding waste is a measured series, not a guess;
+* `heartbeat()` emits an instant trace event + counter tracks
+  (frontier depth, states explored, deepest linearized op, keys still
+  running, shard balance), updates gauges, and accumulates the
+  device-busy wall (`wgl.device_busy_s` — the duty-cycle numerator),
+  so a stalled search is diagnosable mid-flight from trace.jsonl and
+  a live scrape of ``GET /api/metrics`` shows monotonically-increasing
+  explored/frontier series mid-search;
 * `summary()` records the final verdict's telemetry (states explored,
   chunk count, iteration count, dedup-table load / insert failures,
   per-shard work split) into the metrics registry.
 
 Engines call `capture()` ONCE at search entry and use the returned
-session for every emission. The session pins the tracer/registry that
-were bound when the search STARTED: the checker competition abandons
-losing engine threads after a 0.5 s join (they may still be mid
-device-compile), and a straggler reading the process-global sinks per
-call would write phantom heartbeats into the NEXT run's artifacts.
-With captured sinks a straggler keeps streaming into its own (already
+session for every emission. The session pins the sinks resolved
+through ``obs.current_sinks()`` when the search STARTED: the
+RUN-SCOPED pair when inside a run scope (two concurrent campaign
+cells' searches each write their own {campaign, cell}-labelled
+series instead of folding into whichever cell bound last), else the
+process globals. The checker competition abandons losing engine
+threads after a 0.5 s join (they may still be mid device-compile),
+and a straggler reading the process-global sinks per call would
+write phantom heartbeats into the NEXT run's artifacts. With
+captured sinks a straggler keeps streaming into its own (already
 discarded) buffers — harmless.
 
 Everything no-ops while obs is unbound, so the engines pay one global
@@ -32,9 +42,12 @@ magnitude.
 
 from __future__ import annotations
 
-from . import registry, tracer
+import time as _time
 
-__all__ = ["capture", "enabled", "SearchObs"]
+from . import current_sinks, run_config
+
+__all__ = ["capture", "enabled", "SearchObs",
+           "HEARTBEAT_MIN_INTERVAL_S"]
 
 #: wall-time buckets for per-chunk dispatch latency: chunks target
 #: ~1-3 s; the tail buckets catch TPU-tunnel stalls (observed: single
@@ -42,36 +55,93 @@ __all__ = ["capture", "enabled", "SearchObs"]
 CHUNK_BUCKETS_S = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                    30.0, 60.0, 120.0, 300.0, 600.0)
 
+#: the fastest cadence heartbeats can fire at: one per host→device
+#: dispatch, and the batch loop targets ~1 s dispatches. A
+#: ``progress-interval-s`` below this cannot make progress telemetry
+#: any fresher (planlint PL019 warns on it).
+HEARTBEAT_MIN_INTERVAL_S = 1.0
+
 
 def enabled():
-    """Whether obs sinks are currently bound (for gating extra host
-    work like device reads before a `capture()`d session exists)."""
-    return tracer() is not None or registry() is not None
+    """Whether obs sinks are currently resolvable (for gating extra
+    host work like device reads before a `capture()`d session
+    exists)."""
+    tr, reg = current_sinks()
+    return tr is not None or reg is not None
 
 
 def capture():
-    """Snapshot the currently bound sinks into a search session."""
-    return SearchObs(tracer(), registry())
+    """Snapshot this context's sinks (run-scoped when inside a run,
+    else the globals) into a search session, along with the run's
+    progress-telemetry cadence config."""
+    tr, reg = current_sinks()
+    cfg = run_config()
+    return SearchObs(tr, reg,
+                     min_interval_s=cfg.get("progress-interval-s"))
 
 
 class SearchObs:
-    """One search's telemetry channel, pinned to the sinks bound at
-    search start (see module docstring for why not per-call globals)."""
+    """One search's telemetry channel, pinned to the sinks resolved at
+    search start (see module docstring for why not per-call globals).
 
-    def __init__(self, tr, reg):
+    ``min_interval_s`` throttles the per-dispatch TRACE emission +
+    journal flush (the disk-touching parts) to at most one per
+    interval; registry counters/gauges always update, so the busy-wall
+    and explored accounting stay exact whatever the cadence."""
+
+    def __init__(self, tr, reg, min_interval_s=None):
         self._tr = tr
         self._reg = reg
+        try:
+            self._min_interval = max(0.0, float(min_interval_s or 0.0))
+        except (TypeError, ValueError):
+            self._min_interval = 0.0
+        self._last_emit = 0.0
 
     def enabled(self):
         return self._tr is not None or self._reg is not None
 
+    def plan(self, engine, n_bucket, rows_real, rows_total, keys=None,
+             lanes=None):
+        """Record one search's padded-batch composition, once at
+        entry: ``rows_real`` real op rows landed in a padded batch of
+        ``rows_total`` rows (``lanes`` x ``n_bucket`` for the key
+        batch). The per-bucket real/padded counters are what the
+        campaign fold renders as the padding-waste table."""
+        tr, reg = self._tr, self._reg
+        if tr is None and reg is None:
+            return
+        rows_real = int(rows_real)
+        rows_total = int(rows_total)
+        padded = max(0, rows_total - rows_real)
+        if reg is not None:
+            b = str(int(n_bucket))
+            reg.inc("wgl.cells_real", rows_real, engine=engine,
+                    bucket=b)
+            reg.inc("wgl.cells_padded", padded, engine=engine,
+                    bucket=b)
+        if tr is not None:
+            fields = {"bucket": int(n_bucket), "rows_real": rows_real,
+                      "rows_padded": padded,
+                      "waste_frac": round(padded / rows_total, 4)
+                      if rows_total else 0.0}
+            if keys is not None:
+                fields["keys"] = int(keys)
+            if lanes is not None:
+                fields["lanes"] = int(lanes)
+            tr.instant(f"wgl.plan.{engine}", cat="search", args=fields)
+
     def heartbeat(self, engine, iteration, chunk_s, frontier=None,
-                  explored=None, keys_alive=None, keys_running=None,
-                  compactions=None, shard_tops=None, **extra):
+                  explored=None, depth=None, keys_alive=None,
+                  keys_running=None, compactions=None, shard_tops=None,
+                  **extra):
         """One call per host→device dispatch. ``frontier`` is the DFS
         stack depth (scalar, or summed over keys), ``explored`` the
-        cumulative states-explored counter, ``shard_tops`` the
-        per-shard frontier sizes (the steal-ring balance signal)."""
+        cumulative states-explored counter, ``depth`` the deepest
+        linearized-ok-op count reached so far (the "wedged at op K
+        with frontier F" watchdog signal — progress toward n_ok),
+        ``shard_tops`` the per-shard frontier sizes (the steal-ring
+        balance signal)."""
         tr, reg = self._tr, self._reg
         if tr is None and reg is None:
             return
@@ -79,6 +149,10 @@ class SearchObs:
             reg.inc("wgl.chunks", engine=engine)
             reg.observe("wgl.chunk_s", chunk_s,
                         buckets=CHUNK_BUCKETS_S, engine=engine)
+            # duty-cycle numerator: device-busy wall accumulated per
+            # dispatch (the sync rides the dispatch, so chunk_s IS the
+            # device-occupancy bound the host loop observed)
+            reg.inc("wgl.device_busy_s", float(chunk_s), engine=engine)
         fields = {"iteration": iteration, "chunk_s": round(chunk_s, 4)}
         track = {}
         if frontier is not None:
@@ -92,6 +166,14 @@ class SearchObs:
             fields["explored"] = track["explored"] = int(explored)
             if reg is not None:
                 reg.set_gauge("wgl.states_explored", int(explored),
+                              engine=engine)
+        if depth is not None:
+            fields["depth"] = track["depth"] = int(depth)
+            if reg is not None:
+                # the deepest linearized-ok count is monotone per
+                # search; max_gauge keeps it monotone across the
+                # compaction rebuilds of the batch path too
+                reg.max_gauge("wgl.search_depth", int(depth),
                               engine=engine)
         if keys_alive is not None:
             fields["keys_alive"] = int(keys_alive)
@@ -112,6 +194,14 @@ class SearchObs:
                 reg.set_gauge("wgl.shards_with_work", busy,
                               engine=engine)
         fields.update(extra)
+        # trace emission + journal flush throttle: registry state
+        # above is already current, so skipping the disk-touching
+        # tail only coarsens the TRACE's sampling of it
+        now = _time.monotonic()
+        if self._min_interval and now - self._last_emit \
+                < self._min_interval:
+            return
+        self._last_emit = now
         if tr is not None:
             tr.instant(f"wgl.heartbeat.{engine}", cat="search",
                        args=fields)

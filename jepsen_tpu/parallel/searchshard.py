@@ -123,6 +123,10 @@ def check_encoded_sharded(spec, e, init_state, mesh,
     timed_out = False
     # sinks captured once at search start (see obs.search docstring)
     so = obs_search.capture()
+    # padding accounting: one real history of len(e) rows in an
+    # n_pad-row plan (the D-way replication of the op columns is
+    # sharding, not padding, so it does not count as waste)
+    so.plan("jax-wgl-sharded", n_pad, len(e), n_pad)
     it = 0
     eff = min(chunk_iters, 32, max(1, (32 * 16384) // n_pad))
     while True:
@@ -130,17 +134,26 @@ def check_encoded_sharded(spec, e, init_state, mesh,
         t_chunk = _time.monotonic()
         bound = min(it + eff, max_iters)
         carry = run_b(carry, *consts, jnp.int32(bound))
-        status = np.asarray(carry[IDX_STATUS])
-        top = np.asarray(carry[IDX_TOP])
-        it = int(np.asarray(carry[IDX_IT])[0])
+        # ONE batched device_get of the progress tensor (replacing the
+        # three separate per-array transfers): per-shard status/top,
+        # the iteration counter, cumulative explored, and the witness
+        # depths whose max is the deepest linearized-ok count reached
+        status, top, it_g, explored_d, bdepth = jax.device_get(
+            (carry[IDX_STATUS], carry[IDX_TOP], carry[IDX_IT],
+             carry[IDX_EXPLORED], carry[IDX_BEST_DEPTH]))
+        status = np.asarray(status)
+        top = np.asarray(top)
+        it = int(np.asarray(it_g)[0])
         # per-shard frontier sizes ARE the steal-ring balance signal:
         # all work stuck on one shard = the ring is starved. Built from
-        # the arrays this poll already fetched (explored waits for the
-        # summary — no extra per-chunk device reads)
+        # the arrays this poll already fetched — no extra per-chunk
+        # device round trips
         so.heartbeat(
             "jax-wgl-sharded", iteration=it,
             chunk_s=_time.monotonic() - t_chunk,
             frontier=int(top.sum()),
+            explored=int(np.asarray(explored_d).sum()),
+            depth=max(0, int(np.asarray(bdepth).max())),
             shard_tops=[int(t) for t in top])
         if (status == VALID).any() or not ((status == RUNNING)
                                            & (top > 0)).any() \

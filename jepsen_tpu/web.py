@@ -35,6 +35,7 @@ import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import store
+from .obs.metrics import parse_flat_key
 
 logger = logging.getLogger(__name__)
 
@@ -95,8 +96,11 @@ def _fast_tests():
             except (FileNotFoundError, json.JSONDecodeError):
                 valid = "incomplete"
             fake = {"name": name, "start-time": t}
+            # profile.json is the XLA profiler capture's marker
+            # (obs/profile.py), written next to trace.jsonl when a
+            # run was profiled — linked like the other artifacts
             obs_files = [f for f in ("metrics.json", "analysis.json",
-                                     "monitor.json")
+                                     "monitor.json", "profile.json")
                          if os.path.exists(store.path(fake, f))]
             mon = _monitor_header(store.path(fake, "monitor.json")) \
                 if "monitor.json" in obs_files else None
@@ -164,19 +168,9 @@ def _campaign_cell_class(outcome):
     return "valid-unknown"
 
 
-def _flat_key(key):
-    """Parse a flattened metrics key ``name{k=v,...}`` back into
-    ``(name, {k: v})`` — the view-layer inverse of obs.metrics'
-    snapshot keys. Best effort: label VALUES containing ``=``/``,``
-    parse wrong, which costs one utilization-table cell, not data."""
-    if "{" not in key:
-        return key, {}
-    name, _, rest = key.partition("{")
-    labels = {}
-    for part in rest.rstrip("}").split(","):
-        k, _, v = part.partition("=")
-        labels[k] = v
-    return name, labels
+#: the shared flattened-metrics-key parser (one definition for every
+#: consumer)
+_flat_key = parse_flat_key
 
 
 def _utilization_rows(cid, records):
@@ -343,6 +337,8 @@ class Handler(BaseHTTPRequestHandler):
 
     def _send(self, code, body, ctype="text/html; charset=utf-8",
               headers=None):
+        # remembered for the /api SLO accounting in _api's finally
+        self._last_code = code
         if isinstance(body, str):
             body = body.encode()
         self.send_response(code)
@@ -420,8 +416,22 @@ class Handler(BaseHTTPRequestHandler):
         route passes the admission gate first -- token authn (401),
         then per-caller budgets (429 + Retry-After) -- so rejected
         traffic never reaches the request logic, let alone in-flight
-        campaigns."""
+        campaigns. Every response — success or 4xx/5xx — lands in the
+        service SLO registry (per-endpoint request counts + latency
+        histograms) via ``service.note_request``."""
         from .fleet import service
+        import time as _time
+        t0 = _time.monotonic()
+        self._last_code = None
+        try:
+            return self._api_routed(method, path, service)
+        finally:
+            if self._last_code is not None:
+                service.note_request(service.endpoint_of(path),
+                                     self._last_code,
+                                     _time.monotonic() - t0)
+
+    def _api_routed(self, method, path, service):
         try:
             caller = self._caller()
             clean = path.rstrip("/")

@@ -1,0 +1,575 @@
+"""Device-level search introspection: progress-tensor heartbeats off
+the device host loops (explored / frontier / depth monotone on a live
+scrape), padding / duty-cycle accounting per n-bucket, the run-scoped
+sink fix that stops concurrent campaign cells folding their heartbeat
+counters into one series, the --profile XLA capture (and its
+containment when the profiler is unavailable), the service SLO
+histograms on /api/check, the campaign metrics fold, planlint PL019,
+and the trace-summary waste table."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import obs, store, web
+from jepsen_tpu.analysis import planlint
+from jepsen_tpu.checker import jax_wgl
+from jepsen_tpu.fleet import service
+from jepsen_tpu.models import cas_register_spec
+from jepsen_tpu.obs import merge as obs_merge
+from jepsen_tpu.obs import profile as obs_profile
+from jepsen_tpu.obs import search as obs_search
+from jepsen_tpu.parallel import keyshard
+from jepsen_tpu.simulate import random_history
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+    service.reset()
+    yield
+    service.reset()
+
+
+def _hist(n_ops=400, n_procs=8, seed=7):
+    import random as _r
+    return random_history(_r.Random(seed), "cas-register",
+                          n_procs=n_procs, n_ops=n_ops, crash_p=0.02)
+
+
+# ---------------------------------------------------------------------------
+# progress-tensor heartbeats
+
+def test_single_key_heartbeat_carries_progress_tensor():
+    """A single-key device search's heartbeats carry frontier,
+    cumulative explored, AND the deepest linearized-ok depth, and the
+    duty-cycle accounting (device_busy_s, padding per bucket) lands in
+    the registry."""
+    e, st = cas_register_spec.encode(_hist())
+    tr, reg = obs.Tracer(), obs.Registry()
+    with obs.bind(tr, reg):
+        r = jax_wgl.check_encoded(cas_register_spec, e, st,
+                                  chunk_iters=4)
+    assert r["valid"] in (True, False)
+    hb = [ev for ev in tr.events()
+          if ev.get("name") == "wgl.heartbeat.jax-wgl"]
+    assert hb, "no heartbeats for a multi-chunk search"
+    args = hb[-1]["args"]
+    assert {"iteration", "frontier", "explored", "depth",
+            "chunk_s"} <= set(args)
+    assert args["depth"] >= 0
+    # per-dispatch depth is monotone (best_depth only grows)
+    depths = [h["args"]["depth"] for h in hb]
+    assert depths == sorted(depths)
+    assert reg.counter_value("wgl.device_busy_s",
+                             engine="jax-wgl") > 0
+    assert reg.gauge_value("wgl.search_depth", engine="jax-wgl") \
+        == depths[-1]
+    # padding accounting: real rows vs the padded power-of-two bucket
+    snap = reg.snapshot()["counters"]
+    real = [v for k, v in snap.items()
+            if k.startswith("wgl.cells_real{")]
+    padded = [v for k, v in snap.items()
+              if k.startswith("wgl.cells_padded{")]
+    assert real == [len(e)]
+    assert padded and padded[0] >= 0
+    plan_ev = [ev for ev in tr.events()
+               if ev.get("name") == "wgl.plan.jax-wgl"]
+    assert plan_ev and plan_ev[0]["args"]["rows_real"] == len(e)
+
+
+def test_batch_heartbeat_explored_and_depth_ride_one_device_get():
+    """The key batch's heartbeats now carry summed explored + max
+    depth (fetched on the same single device_get as status/top), and
+    the batch's padding accounting counts K * n_pad rows against the
+    live keys' real ops."""
+    pairs = [cas_register_spec.encode(_hist(200, 4, seed=s))
+             for s in (1, 2, 3)]
+    tr, reg = obs.Tracer(), obs.Registry()
+    with obs.bind(tr, reg):
+        rs = keyshard.check_batch_encoded(cas_register_spec, pairs,
+                                          chunk_iters=4)
+    assert all(r["valid"] in (True, False) for r in rs)
+    hb = [ev for ev in tr.events()
+          if ev.get("name") == "wgl.heartbeat.jax-wgl-batch"]
+    assert hb
+    assert {"explored", "depth", "frontier",
+            "keys_running"} <= set(hb[-1]["args"])
+    explored = [h["args"]["explored"] for h in hb]
+    assert explored == sorted(explored), \
+        "batch explored must stay monotone across compactions"
+    snap = reg.snapshot()["counters"]
+    real = sum(v for k, v in snap.items()
+               if k.startswith("wgl.cells_real{")
+               and "jax-wgl-batch" in k)
+    total_rows = sum(len(e) for e, _ in pairs)
+    assert real == total_rows
+    padded = sum(v for k, v in snap.items()
+                 if k.startswith("wgl.cells_padded{")
+                 and "jax-wgl-batch" in k)
+    assert padded > 0, "a 3-key batch pads to a power-of-two lane " \
+                       "count and a common n bucket"
+
+
+def test_progress_interval_throttles_trace_not_accounting():
+    """progress-interval-s thins the trace emissions but the registry
+    accounting (chunks, busy wall) stays exact per dispatch."""
+    tr, reg = obs.Tracer(), obs.Registry()
+    so = obs_search.SearchObs(tr, reg, min_interval_s=3600.0)
+    for i in range(5):
+        so.heartbeat("jax-wgl", iteration=i, chunk_s=0.01, frontier=1,
+                     explored=i, depth=i)
+    hb = [ev for ev in tr.events()
+          if ev.get("name") == "wgl.heartbeat.jax-wgl"]
+    assert len(hb) == 1, "only the first emission within the interval"
+    assert reg.counter_value("wgl.chunks", engine="jax-wgl") == 5
+    assert reg.gauge_value("wgl.states_explored", engine="jax-wgl") \
+        == 4
+
+
+# ---------------------------------------------------------------------------
+# the run-scoped sink fix (satellite: heartbeat namespacing)
+
+def test_capture_prefers_run_scoped_sinks_over_globals():
+    """Two concurrent campaign cells: cell B binds last (owns the
+    process-global pair), but cell A's search — capturing inside A's
+    sink scope — must land its heartbeats in A's registry, under A's
+    {campaign, cell} default labels."""
+    tr_a = obs.Tracer(context={"campaign": "c", "cell": "a"})
+    reg_a = obs.Registry(default_labels={"campaign": "c", "cell": "a"})
+    tr_b = obs.Tracer(context={"campaign": "c", "cell": "b"})
+    reg_b = obs.Registry(default_labels={"campaign": "c", "cell": "b"})
+    with obs.bind(tr_a, reg_a):
+        with obs.bind(tr_b, reg_b):          # B binds last: owns globals
+            assert obs.registry() is reg_b
+            with obs.sink_scope(tr_a, reg_a):
+                so = obs_search.capture()
+            so.heartbeat("jax-wgl", iteration=1, chunk_s=0.1,
+                         frontier=5, explored=10, depth=2)
+    assert reg_a.counter_value("wgl.chunks", engine="jax-wgl") == 1
+    assert reg_b.counter_value("wgl.chunks", engine="jax-wgl") == 0
+    key = "wgl.chunks{campaign=c,cell=a,engine=jax-wgl}"
+    assert reg_a.snapshot()["counters"][key] == 1
+
+
+def test_run_scope_pins_sinks_for_competition_threads():
+    """obs.run_scope sets the contextvar AND the globals; a
+    copy_context thread fan-out (the checker competition's spawn
+    idiom) resolves the run's own pair even after a sibling rebinds
+    the globals."""
+    import contextvars
+    test = {"obs?": True}
+    got = {}
+    with obs.run_scope(test):
+        reg_mine = test["obs"]["registry"]
+        other = obs.Registry()
+
+        def worker():
+            got["sinks"] = obs.current_sinks()
+
+        ctx = contextvars.copy_context()
+        with obs.bind(None, other):      # a sibling steals the globals
+            t = threading.Thread(target=ctx.run, args=(worker,))
+            t.start()
+            t.join()
+    assert got["sinks"][1] is reg_mine
+
+
+def test_live_registries_exposes_every_open_bind():
+    r1, r2 = obs.Registry(), obs.Registry()
+    with obs.bind(None, r1):
+        with obs.bind(None, r2):
+            live = obs.live_registries()
+            assert r1 in live and r2 in live
+    assert obs.live_registries() == []
+
+
+# ---------------------------------------------------------------------------
+# live scrape: monotone explored/frontier on /api/metrics mid-search
+
+@pytest.fixture
+def token_server():
+    server = web.serve({"ip": "127.0.0.1", "port": 0,
+                        "token": "sekrit"})
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def _get(base, path, token=None):
+    req = urllib.request.Request(base + path)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read().decode(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), dict(e.headers)
+
+
+def _prom_value(body, prefix):
+    out = []
+    for line in body.splitlines():
+        if line.startswith(prefix) and not line.startswith("# "):
+            out.append(float(line.rsplit(" ", 1)[1]))
+    return out
+
+
+@pytest.mark.slow
+def test_live_search_exposes_monotone_progress_on_api_metrics(
+        token_server):
+    """THE acceptance criterion: while a device search runs, GET
+    /api/metrics serves its explored-configs and frontier-occupancy
+    series, and explored increases monotonically across scrapes. The
+    401 gate is unchanged."""
+    status, _, _ = _get(token_server, "/api/metrics")
+    assert status == 401
+    e, st = cas_register_spec.encode(_hist(1200, 16, seed=11))
+    tr, reg = obs.Tracer(), obs.Registry()
+    done = threading.Event()
+    box = {}
+
+    def search():
+        with obs.bind(tr, reg):
+            try:
+                # 1-iteration dispatches: many heartbeats, so scrapes
+                # land between them
+                box["r"] = jax_wgl.check_encoded(
+                    cas_register_spec, e, st, chunk_iters=1)
+            finally:
+                done.set()
+
+    t = threading.Thread(target=search)
+    t.start()
+    explored_seen = []
+    families_seen = set()
+    try:
+        while not done.is_set():
+            _, body, _ = _get(token_server, "/api/metrics",
+                              token="sekrit")
+            explored_seen += _prom_value(
+                body, "jepsen_wgl_states_explored{")
+            for fam in ("jepsen_wgl_frontier_depth",
+                        "jepsen_wgl_cells_real",
+                        "jepsen_wgl_cells_padded",
+                        "jepsen_wgl_device_busy_s"):
+                if fam in body:
+                    families_seen.add(fam)
+            time.sleep(0.02)
+    finally:
+        t.join()
+    assert box["r"]["valid"] in (True, False)
+    assert explored_seen, "no mid-search scrape saw the explored gauge"
+    assert explored_seen == sorted(explored_seen), \
+        "explored-configs must increase monotonically"
+    # the frontier + padding-accounting families were served mid-run
+    assert len(families_seen) == 4, families_seen
+    # the frontier gauge family was served too (final state persists)
+    _, body, _ = _get(token_server, "/api/metrics", token="sekrit")
+    # search finished: bind closed, so live_registries is empty again;
+    # the SLO families from our own scrapes remain
+    assert "jepsen_service_requests" in body
+    assert "jepsen_service_request_s_bucket" in body
+
+
+# ---------------------------------------------------------------------------
+# service SLOs
+
+def test_check_history_records_slo_histograms():
+    hist = [{"type": "invoke", "process": 0, "f": "write", "value": 1},
+            {"type": "ok", "process": 0, "f": "write", "value": 1},
+            {"type": "invoke", "process": 0, "f": "read", "value": None},
+            {"type": "ok", "process": 0, "f": "read", "value": 1}]
+    out = service.check_history({"history": hist, "engine": "linear"})
+    assert out["valid"] is True
+    reg = service.slo_registry()
+    h = reg.histogram("service.verdict_latency_s", endpoint="check",
+                      valid="True")
+    assert h is not None and h.count == 1
+    qw = reg.histogram("service.queue_wait_s", endpoint="check")
+    assert qw is not None and qw.count == 1
+    body = service.metrics_text()
+    assert "jepsen_service_verdict_latency_s_bucket" in body
+    assert "jepsen_service_queue_wait_s_count" in body
+    # deterministic render (same inputs -> same body)
+    assert body == service.metrics_text()
+
+
+def test_note_request_counts_errors_too():
+    service.note_request("check", 400, 0.01)
+    service.note_request("check", 200, 0.02)
+    reg = service.slo_registry()
+    assert reg.counter_value("service.requests", endpoint="check",
+                             status="400") == 1
+    assert reg.counter_value("service.requests", endpoint="check",
+                             status="200") == 1
+    assert reg.histogram("service.request_s",
+                         endpoint="check").count == 2
+
+
+def test_api_request_accounting_over_a_socket(token_server):
+    _get(token_server, "/api/metrics", token="sekrit")
+    _get(token_server, "/api/metrics", token="sekrit")
+    reg = service.slo_registry()
+    assert reg.counter_value("service.requests", endpoint="metrics",
+                             status="200") >= 2
+    # a 401 is accounted too
+    _get(token_server, "/api/metrics")
+    assert reg.counter_value("service.requests", endpoint="metrics",
+                             status="401") >= 1
+
+
+# ---------------------------------------------------------------------------
+# --profile capture
+
+def test_profile_scope_unavailable_is_contained(tmp_path, monkeypatch):
+    """The CI containment contract: JEPSEN_NO_PROFILER forces the
+    profiler unavailable, the body still runs, and the marker records
+    why."""
+    monkeypatch.setenv("JEPSEN_NO_PROFILER", "1")
+    assert not obs_profile.available()
+    pdir = str(tmp_path / "prof" / "profile")
+    test = {"profile?": True, "profile-dir": pdir}
+    ran = []
+    with obs_profile.scope(test) as captured:
+        ran.append(captured)
+    assert ran == [None]
+    marker = json.loads(
+        (tmp_path / "prof" / "profile.json").read_text())
+    assert marker["status"] == "unavailable"
+
+
+def test_profile_scope_captures_when_available(tmp_path):
+    if not obs_profile.available():
+        pytest.skip("jax.profiler unavailable in this environment")
+    pdir = str(tmp_path / "prof" / "profile")
+    test = {"profile?": True, "profile-dir": pdir,
+            "profile-max-s": 30}
+    with obs_profile.scope(test) as captured:
+        assert captured == pdir
+        # some device work to profile
+        e, st = cas_register_spec.encode(_hist(100, 4))
+        jax_wgl.check_encoded(cas_register_spec, e, st)
+    marker = json.loads(
+        (tmp_path / "prof" / "profile.json").read_text())
+    assert marker["status"] == "done", marker
+    assert os.path.isdir(pdir)
+
+
+def test_profile_scope_never_raises_on_bad_dir(tmp_path):
+    test = {"profile?": True,
+            "profile-dir": "/proc/definitely/not/writable/x"}
+    with obs_profile.scope(test):
+        pass  # must not raise whatever the profiler did
+
+
+def test_web_links_profile_marker(tmp_path, monkeypatch):
+    """The home table links profile.json like the other obs
+    artifacts."""
+    fake = {"name": "t-prof", "start-time": "20260101T000000"}
+    d = store.path(fake)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "results.json"), "w") as f:
+        json.dump({"valid": True}, f)
+    with open(os.path.join(d, "profile.json"), "w") as f:
+        json.dump({"status": "done"}, f)
+    page = web._home_page()
+    assert "profile.json" in page
+
+
+# ---------------------------------------------------------------------------
+# campaign metrics fold
+
+def _write_run_metrics(d, counters):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "metrics.json"), "w") as f:
+        json.dump({"counters": counters, "gauges": {},
+                   "histograms": {}}, f)
+
+
+def test_fold_campaign_metrics_sums_and_summarizes(tmp_path):
+    cid = "fold-test"
+    os.makedirs(store.campaign_path(cid), exist_ok=True)
+    with open(store.campaign_path(cid, "campaign.json"), "w") as f:
+        json.dump({"meta": {"id": cid, "cells": ["a", "b"]}}, f)
+    # the coordinator's own snapshot carries the dispatcher's LIVE
+    # cell-labelled re-folds of the same run metrics (plus its own
+    # fleet counters): the fold must skip the re-folds — summing both
+    # would double every wgl counter — while keeping the fleet series
+    _write_run_metrics(store.campaign_path(cid), {
+        "fleet.cells{outcome=True}": 2,
+        "wgl.cells_real{bucket=64,cell=a,engine=jax-wgl}": 40,
+        "wgl.cells_real{bucket=64,cell=b,engine=jax-wgl}": 40,
+        "wgl.device_busy_s{cell=a,engine=jax-wgl}": 1.5,
+        "wgl.device_busy_s{cell=b,engine=jax-wgl}": 1.5})
+    from jepsen_tpu.campaign.journal import CampaignJournal
+    jr = CampaignJournal(cid)
+    runs = []
+    for i, cell in enumerate(("a", "b")):
+        d = os.path.join(store.base_dir, f"run-{cell}",
+                         "20260101T00000" + str(i))
+        _write_run_metrics(d, {
+            "wgl.cells_real{bucket=64,cell=%s,engine=jax-wgl}"
+            % cell: 40,
+            "wgl.cells_padded{bucket=64,cell=%s,engine=jax-wgl}"
+            % cell: 24,
+            "wgl.device_busy_s{cell=%s,engine=jax-wgl}" % cell: 1.5})
+        jr.append_cell({"cell": cell, "outcome": True, "path": d})
+        runs.append(d)
+    fold = obs_merge.fold_campaign_metrics(cid)
+    assert fold["runs_folded"] == 3     # coordinator + 2 cell runs
+    assert os.path.exists(store.campaign_path(cid,
+                                              "metrics_fold.json"))
+    # the coordinator's non-cell series folded; its cell-labelled
+    # re-folds did NOT (the waste table below would otherwise double)
+    assert fold["counters"]["fleet.cells{outcome=True}"] == 2
+    summary = obs_merge.introspection_summary(fold, makespan_s=10.0)
+    assert summary["padding"]["64"]["real"] == 80
+    assert summary["padding"]["64"]["padded"] == 48
+    assert summary["padding"]["64"]["waste_frac"] == \
+        pytest.approx(48 / 128, abs=1e-4)
+    assert summary["device_busy_total_s"] == pytest.approx(3.0)
+    assert summary["duty_cycle"] == pytest.approx(0.3)
+    # deterministic persist
+    with open(store.campaign_path(cid, "metrics_fold.json"),
+              "rb") as f:
+        body = f.read()
+    obs_merge.fold_campaign_metrics(cid)
+    with open(store.campaign_path(cid, "metrics_fold.json"),
+              "rb") as f:
+        assert f.read() == body
+
+
+def test_trace_summary_prints_waste_table(tmp_path):
+    """The run summary renders the padding-waste table + duty cycle
+    from a run's metrics.json."""
+    import subprocess
+    import sys
+    d = str(tmp_path / "run")
+    _write_run_metrics(d, {
+        "wgl.cells_real{bucket=128,engine=jax-wgl}": 100,
+        "wgl.cells_padded{bucket=128,engine=jax-wgl}": 28,
+        "wgl.device_busy_s{engine=jax-wgl}": 0.5})
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "trace_summary.py"), d],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "padding waste" in out.stdout
+    assert "128" in out.stdout
+    assert "device duty cycle" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# planlint PL019
+
+def _codes(diags, sev=None):
+    return [d.code for d in diags
+            if sev is None or d.severity == sev]
+
+
+def test_pl019_rules(tmp_path):
+    from jepsen_tpu.analysis.diagnostics import ERROR, WARNING
+
+    # profile with telemetry disabled = error
+    diags = planlint.lint_introspection({"profile?": True,
+                                         "obs?": False,
+                                         "name": "t"})
+    assert "PL019" in _codes(diags, ERROR)
+    # profile on an unnamed TEST MAP with no dir = error; a plain
+    # options map (campaign/fleet lint) skips — cells are named at
+    # build time
+    diags = planlint.lint_introspection({"profile?": True,
+                                         "checker": object()})
+    assert "PL019" in _codes(diags, ERROR)
+    assert planlint.lint_introspection({"profile?": True}) == []
+    # unwritable profile-dir = error
+    diags = planlint.lint_introspection(
+        {"profile?": True,
+         "profile-dir": "/proc/nope/never/profile"})
+    assert "PL019" in _codes(diags, ERROR)
+    # writable dir + named test = clean
+    ok_dir = str(tmp_path / "prof")
+    assert planlint.lint_introspection(
+        {"profile?": True, "profile-dir": ok_dir}) == []
+    assert planlint.lint_introspection(
+        {"profile?": True, "name": "t"}) == []
+    # cadence below the heartbeat interval = warning
+    diags = planlint.lint_introspection(
+        {"progress-interval-s": 0.1})
+    assert "PL019" in _codes(diags, WARNING)
+    # non-positive cadence = warning
+    diags = planlint.lint_introspection(
+        {"progress-interval-s": -1})
+    assert "PL019" in _codes(diags, WARNING)
+    # bad profile-max-s = warning
+    diags = planlint.lint_introspection(
+        {"profile?": True, "name": "t", "profile-max-s": 0})
+    assert "PL019" in _codes(diags, WARNING)
+    # sane knobs = clean
+    assert planlint.lint_introspection(
+        {"progress-interval-s": 5.0}) == []
+    assert planlint.lint_introspection({}) == []
+
+
+def test_pl019_rides_lint_plan():
+    from jepsen_tpu import tests as tst
+    from jepsen_tpu.analysis.diagnostics import WARNING
+    t = tst.noop_test()
+    t["ssh"] = {"dummy?": True}
+    t["progress-interval-s"] = 0.01
+    diags = [d for d in planlint.lint_plan(t) if d.code == "PL019"]
+    assert diags and diags[0].severity == WARNING
+
+
+# ---------------------------------------------------------------------------
+# end to end: a profiled, introspected run
+
+def test_run_with_profile_and_progress_interval(monkeypatch):
+    """core.run with profile? on (profiler forced unavailable:
+    containment) and a progress cadence still passes, persists the
+    marker, and its metrics carry the padding accounting."""
+    monkeypatch.setenv("JEPSEN_NO_PROFILER", "1")
+    import random as _r
+    from jepsen_tpu import core, generator as gen
+    from jepsen_tpu import tests as tst
+    from jepsen_tpu.checker import checkers as ck
+    from jepsen_tpu.tests import Atom
+    state = Atom(None)
+    rng = _r.Random(3)
+    t = tst.noop_test()
+    t.update({
+        "name": "introspect-e2e",
+        "ssh": {"dummy?": True},
+        "db": tst.atom_db(state),
+        "client": tst.atom_client(state),
+        "concurrency": 2,
+        "profile?": True,
+        "progress-interval-s": 30.0,
+        "searchplan?": False,
+        "generator": gen.clients(gen.limit(12, gen.mix([
+            lambda: {"f": "read"},
+            lambda: {"f": "write", "value": rng.randint(0, 3)},
+        ]))),
+        "checker": ck.linearizable({
+            "model": "cas-register", "algorithm": "jax-wgl",
+            "init-ops": [{"f": "write", "value": 0}]}),
+    })
+    test = core.run(t)
+    assert test["results"]["valid"] is True, test["results"]
+    marker = store.path(test, "profile.json")
+    assert os.path.exists(marker)
+    assert json.load(open(marker))["status"] == "unavailable"
+    m = json.loads(
+        open(store.path(test, "metrics.json")).read())
+    assert any(k.startswith("wgl.cells_real{")
+               for k in m["counters"])
+    assert any(k.startswith("wgl.device_busy_s{")
+               for k in m["counters"])

@@ -129,6 +129,16 @@ def summarize(store_dir):
             for k, v in wgl.items():
                 lines.append(f"{v!s:>12}  {k}")
 
+        # per-bucket padding waste + device duty cycle (the run's
+        # whole-trace wall is the duty denominator)
+        run_wall_s = None
+        xs = [e for e in events if e.get("ph") == "X"]
+        if xs:
+            run_wall_s = (max(e.get("ts", 0.0) + e.get("dur", 0.0)
+                              for e in xs)
+                          - min(e.get("ts", 0.0) for e in xs)) / 1e6
+        lines += _introspection_lines(metrics, run_wall_s)
+
         mon = {k: v for k, v in sorted(counters.items())
                if k.startswith("monitor.")}
         mon.update({k: v for k, v in
@@ -160,6 +170,57 @@ def summarize(store_dir):
     if len(lines) == 1:
         lines.append("(no trace.jsonl / metrics.json found)")
     return "\n".join(lines)
+
+
+def _introspection_lines(metrics_like, wall_s=None):
+    """The padding-waste table + duty-cycle lines from any metrics
+    snapshot/fold dict; [] when the run recorded no padding
+    accounting (pre-introspection artifacts)."""
+    from jepsen_tpu.obs.merge import introspection_summary
+    summary = introspection_summary(metrics_like, makespan_s=wall_s)
+    lines = []
+    if summary.get("padding"):
+        lines.append("\n-- padding waste (per n-bucket) --")
+        lines.append(f"{'bucket':>8}  {'real':>10}  {'padded':>10}  "
+                     "waste")
+        for b, st in summary["padding"].items():
+            lines.append(f"{b:>8}  {st['real']:>10}  "
+                         f"{st['padded']:>10}  "
+                         f"{st['waste_frac'] * 100:5.1f}%")
+    busy = summary.get("device_busy_s") or {}
+    if busy:
+        lines.append("\n-- device duty cycle --")
+        for eng, s in busy.items():
+            lines.append(f"{s:10.3f}s  busy ({eng})")
+        if summary.get("duty_cycle") is not None:
+            lines.append(f"{summary['duty_cycle'] * 100:9.1f}%  "
+                         "duty cycle (busy / wall; >100% = "
+                         "overlapping searches across workers)")
+        elif wall_s is None:
+            lines.append("(no trace wall to compute the duty cycle "
+                         "against)")
+    return lines
+
+
+def _store_rooted_at(campaign_dir):
+    """Context manager: point jepsen_tpu.store at the store that owns
+    ``campaign_dir`` (…/store/campaigns/<id> → …/store) and restore
+    it — the one place both in-process fallbacks (the fleetlint audit
+    and the metrics fold) mutate the module global."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def scope():
+        from jepsen_tpu import store
+        old = store.base_dir
+        store.base_dir = os.path.dirname(
+            os.path.dirname(campaign_dir))
+        try:
+            yield os.path.basename(campaign_dir)
+        finally:
+            store.base_dir = old
+
+    return scope()
 
 
 def _resolve_campaign_dir(arg):
@@ -209,6 +270,7 @@ def summarize_campaign(campaign_dir):
         pass
 
     # -- lanes ----------------------------------------------------------
+    makespan_s = None
     lanes = {int(e["pid"]): (e.get("args") or {}).get("name", "?")
              for e in events
              if e.get("ph") == "M" and e.get("name") == "process_name"}
@@ -229,6 +291,7 @@ def summarize_campaign(campaign_dir):
         t_lo = min(e.get("ts", 0.0) for e in xs)
         t_hi = max(e.get("ts", 0.0) + e.get("dur", 0.0) for e in xs)
         makespan_us = t_hi - t_lo
+        makespan_s = makespan_us / 1e6
         # the coordinator's fleet.cell spans cover lease exec end to
         # end; runs merged from worker lanes carry jepsen.run
         cell_spans = [e for e in xs if e.get("name") == "fleet.cell"] \
@@ -306,6 +369,26 @@ def summarize_campaign(campaign_dir):
         for k, v in fleet.items():
             lines.append(f"{v!s:>12}  {k}")
 
+    # -- device introspection: per-bucket padding waste + duty cycle ----
+    # (metrics_fold.json is the per-cell fold run_fleet writes at
+    # finalize; fold in process when it is missing — read-only)
+    fold = None
+    try:
+        with open(os.path.join(campaign_dir,
+                               "metrics_fold.json")) as f:
+            fold = json.load(f)
+    except (OSError, ValueError):
+        pass
+    if fold is None:
+        try:
+            from jepsen_tpu.obs.merge import fold_campaign_metrics
+            with _store_rooted_at(campaign_dir) as cid:
+                fold = fold_campaign_metrics(cid, persist=False)
+        except Exception:  # noqa: BLE001 - the summary must print
+            fold = None
+    if fold is not None:
+        lines += _introspection_lines(fold, makespan_s)
+
     # -- control-plane audit (analysis.fleetlint) -----------------------
     fa = _fleet_audit(campaign_dir)
     if fa is None:
@@ -341,16 +424,9 @@ def _fleet_audit(campaign_dir):
     except (OSError, ValueError):
         pass
     try:
-        from jepsen_tpu import store
         from jepsen_tpu.analysis import fleetlint
-        base = os.path.dirname(os.path.dirname(campaign_dir))
-        cid = os.path.basename(campaign_dir)
-        old = store.base_dir
-        store.base_dir = base
-        try:
+        with _store_rooted_at(campaign_dir) as cid:
             report, _diags = fleetlint.audit(cid, persist=False)
-        finally:
-            store.base_dir = old
         return report
     except Exception:  # noqa: BLE001 - the summary must still print
         return None

@@ -682,6 +682,157 @@ def _introspection_overhead_rung(pairs=5, n_ops=2000):
         return {"error": repr(exc)}
 
 
+def _service_throughput_rung(clients=8, per_client=3, bursts=10):
+    """Batched multi-tenant checking (rung 13): N concurrent clients
+    driving ONE live server over loopback, the same mixed
+    valid/invalid submissions checked with coalescing OFF then ON
+    (the per-request "coalesce" payload knob against a
+    coalescing-enabled server, so transport, admission, and engine
+    stay constant across modes — only the batching differs).
+
+    Each mode's fan-out runs twice and the SECOND pass is the timed
+    one: the solo path and every pow-2 batch width the coalescer
+    closes compile on the first pass, so the timed numbers compare
+    steady-state dispatch, not compiles. Reports checks/s, p50/p99
+    verdict latency, batches/segments/occupancy from the service
+    coalesce counters, the device duty cycle (wgl.device_busy_s over
+    the mode wall, the PR 13 metrics plane), and verdict equality
+    across modes. Self-contained and never fatal."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    try:
+        from jepsen_tpu import obs, web
+        from jepsen_tpu.fleet import service
+
+        service.reset()
+        # every loopback client shares one caller id (no tokens):
+        # budgets must admit the whole fan-out without shedding
+        service.configure(budgets={"concurrent-checks": 4 * clients,
+                                   "queue-depth": 8 * clients})
+        server = web.serve({"ip": "127.0.0.1", "port": 0})
+        port = server.server_address[1]
+        url = f"http://127.0.0.1:{port}/api/check"
+
+        def hist(bad):
+            ev = []
+
+            def e(t, p, f, v):
+                ev.append({"type": t, "process": p, "f": f,
+                           "value": v})
+
+            for j in range(bursts):
+                x = j * 10
+                e("invoke", 0, "write", x)
+                e("invoke", 1, "write", x + 1)
+                e("ok", 0, "write", x)
+                e("ok", 1, "write", x + 1)
+                e("invoke", 0, "write", x + 5)
+                e("ok", 0, "write", x + 5)
+            e("invoke", 2, "read", None)
+            # the stale read targets a genuinely-written value, so
+            # invalidity needs the real search, not the abstraction
+            e("ok", 2, "read", 0 if bad else (bursts - 1) * 10 + 5)
+            return ev
+
+        # shape-identical across clients (one compile bucket, the
+        # cross-tenant ledger-hit case); every 4th client submits a
+        # violation so batches mix valid and invalid
+        hists = [[hist(bad=(c % 4 == 3)) for _ in range(per_client)]
+                 for c in range(clients)]
+
+        def post(h, coalesce):
+            body = _json.dumps({"history": h, "model": "cas-register",
+                                "coalesce": coalesce,
+                                "timeout-s": 120}).encode()
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.monotonic()
+            with urllib.request.urlopen(req, timeout=600) as r:
+                got = _json.loads(r.read())
+            return time.monotonic() - t0, got["valid"]
+
+        def reg_busy():
+            reg = obs.registry()
+            if reg is None:
+                return 0.0
+            return sum(v for k, v in
+                       reg.snapshot()["counters"].items()
+                       if k.startswith("wgl.device_busy_s"))
+
+        def fan_out(flag):
+            lat = [[None] * per_client for _ in range(clients)]
+            vrd = [[None] * per_client for _ in range(clients)]
+            errors = []
+
+            def one_client(c):
+                for i in range(per_client):
+                    try:
+                        lat[c][i], vrd[c][i] = post(hists[c][i], flag)
+                    except Exception as exc:  # noqa: BLE001
+                        errors.append(repr(exc)[:120])
+
+            threads = [threading.Thread(target=one_client, args=(c,))
+                       for c in range(clients)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return (time.monotonic() - t0, lat, vrd, errors)
+
+        out = {"clients": clients, "per_client": per_client,
+               "ops_per_check": 6 * bursts + 1}
+        verdicts = {}
+        for mode, flag in (("off", False), ("on", True)):
+            fan_out(flag)                     # warm pass: compiles
+            st0 = service.coalescer().stats()
+            busy0 = reg_busy()
+            wall, lat, vrd, errors = fan_out(flag)
+            st1 = service.coalescer().stats()
+            busy = reg_busy() - busy0
+            flat = sorted(x for row in lat for x in row
+                          if x is not None)
+            n = len(flat)
+            verdicts[mode] = [v for row in vrd for v in row]
+            out[mode] = {
+                "wall_s": round(wall, 3),
+                "checks_per_s": round(n / wall, 2) if wall else None,
+                "p50_ms": round(flat[n // 2] * 1000, 1) if n else None,
+                "p99_ms": round(flat[min(n - 1, int(0.99 * n))]
+                                * 1000, 1) if n else None,
+                "errors": errors[:5],
+                "batches": st1["batches"] - st0["batches"],
+                "segments": st1["segments"] - st0["segments"],
+                "device_busy_s": round(busy, 3),
+                "duty_cycle": round(busy / wall, 4) if wall else None,
+            }
+        st = service.coalescer().stats()
+        out["occupancy"] = st["occupancy"]
+        out["verdicts_identical"] = verdicts["on"] == verdicts["off"]
+        out["violations_detected"] = sum(
+            1 for v in verdicts["on"] if v is False)
+        if out["off"]["checks_per_s"] and out["on"]["checks_per_s"]:
+            out["coalesce_speedup_x"] = round(
+                out["on"]["checks_per_s"]
+                / out["off"]["checks_per_s"], 2)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/metrics",
+                timeout=30) as r:
+            text = r.read().decode()
+        out["metrics_exposed"] = (
+            "jepsen_service_coalesce_batches" in text
+            and "jepsen_service_coalesce_occupancy" in text
+            and "jepsen_admission_shed_total" in text)
+        server.shutdown()
+        service.reset()
+        return out
+    except Exception as exc:  # noqa: BLE001 - numbers, not crashes
+        return {"error": repr(exc)[:300]}
+
+
 def _error_headline(msg):
     """The zero-value headline shape every bench failure path emits
     (one definition so error lines can't drift from success lines)."""
@@ -1179,6 +1330,12 @@ def _bench_body(_obs_reg):
     # obs off, and the detail re-baselines explored-configs and the
     # device duty cycle for the optimization arc
     rungs["12-introspection-overhead"] = _introspection_overhead_rung()
+
+    # service-throughput rung: the cross-tenant coalescer must turn
+    # queued /api/check wait into device occupancy — coalescing ON
+    # strictly beats OFF on checks/s at concurrency >= 8 with
+    # per-submission verdicts identical to the solo path
+    rungs["13-service-throughput"] = _service_throughput_rung()
 
     # CPU oracles race in parallel subprocesses AFTER all device
     # measurements (their CPU load would pollute the device numbers);

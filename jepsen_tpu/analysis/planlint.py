@@ -84,6 +84,15 @@ Codes:
                  tighter interval buys nothing), or a non-positive /
                  non-numeric progress-interval-s or profile-max-s
                  (the default applies instead) -- warnings
+  PL020 mixed    cross-tenant coalescing: a non-positive / non-numeric
+                 coalesce window or segment cap (a batch could never
+                 close sanely) -- errors; coalescing enabled with
+                 zero device slots (submitted checks never reach a
+                 device, so there is nothing to batch) or with a
+                 configured engine other than jax-wgl (only the
+                 device engine has a key axis to batch on; everything
+                 else takes the solo path and the knob is a no-op)
+                 -- warnings
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -103,7 +112,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["lint_plan", "lint_campaign", "lint_fleet", "lint_service",
            "lint_telemetry", "lint_fleetlint", "lint_introspection",
-           "preflight",
+           "lint_coalesce", "preflight",
            "PlanLintError", "FATAL_CODES", "FLEETLINT_MODES",
            "monitor_diags", "searchplan_diags"]
 
@@ -749,6 +758,59 @@ def lint_service(cfg):
             "worker-death detection bound itself",
             "fleet.sync-timeout-s",
             "keep the artifact-sync budget well under the lease TTL"))
+    return diags
+
+
+def lint_coalesce(cfg):
+    """PL020: cross-tenant coalescing preflight, before any batcher
+    thread starts. Recognized keys: ``coalesce?`` (whether queued
+    /api/check submissions merge into padded device batches),
+    ``coalesce-window-ms``, ``coalesce-max-segments``,
+    ``device-slots``, and ``engine`` (a configured default check
+    engine, when the option map carries one)."""
+    diags = []
+    cfg = cfg or {}
+    w = cfg.get("coalesce-window-ms")
+    if w is not None and (not isinstance(w, (int, float))
+                          or isinstance(w, bool) or w <= 0):
+        diags.append(diag(
+            "PL020", ERROR,
+            f"coalesce-window-ms must be a positive number, got "
+            f"{w!r}",
+            "service.coalesce-window-ms",
+            "the window is how long a submission waits for strangers "
+            "to batch with; omit the knob for the 25 ms default"))
+    m = cfg.get("coalesce-max-segments")
+    if m is not None and (not isinstance(m, int)
+                          or isinstance(m, bool) or m <= 0):
+        diags.append(diag(
+            "PL020", ERROR,
+            f"coalesce-max-segments must be a positive integer, got "
+            f"{m!r}",
+            "service.coalesce-max-segments",
+            "the cap bounds the batch's key axis (and the blast "
+            "radius of one batch failure); omit it for the default"))
+    if cfg.get("coalesce?"):
+        slots = cfg.get("device-slots")
+        if isinstance(slots, int) and not isinstance(slots, bool) \
+                and slots == 0:
+            diags.append(diag(
+                "PL020", WARNING,
+                "coalescing enabled with zero device slots: submitted "
+                "checks never reach a device, so there is nothing to "
+                "batch",
+                "service.coalesce",
+                "give the serving fleet at least one device slot, or "
+                "drop --coalesce"))
+        eng = cfg.get("engine")
+        if eng is not None and str(eng) != "jax-wgl":
+            diags.append(diag(
+                "PL020", WARNING,
+                f"coalescing enabled but the configured engine is "
+                f"{eng!r}: only jax-wgl submissions batch (the CPU "
+                "engines have no key axis), so every check takes the "
+                "solo path and the knob is a no-op",
+                "service.coalesce"))
     return diags
 
 

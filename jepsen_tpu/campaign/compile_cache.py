@@ -44,7 +44,8 @@ import threading
 
 from .. import obs
 
-__all__ = ["bucket", "note", "stats", "reset", "n_floor", "set_n_floor",
+__all__ = ["bucket", "bucket_for", "note", "stats", "reset", "n_floor",
+           "set_n_floor",
            "bucket_floor", "DEFAULT_N_FLOOR", "set_ledger", "get_ledger"]
 
 #: default minimum op-count bucket (matches jax_wgl's historical 64)
@@ -63,6 +64,17 @@ def bucket(x, lo=1):
     (same math as checker.jax_wgl._bucket, restated here so callers
     can predict which cells will share a compile)."""
     return max(lo, 1 << (max(1, int(x)) - 1).bit_length())
+
+
+def bucket_for(n_ops):
+    """The op-count shape bucket an encoded history of ``n_ops`` rows
+    pads to under the CURRENT floor -- ``bucket(n_ops, n_floor())`` in
+    one call. This is the grouping key the fleet service's
+    cross-tenant coalescer batches ``/api/check`` segments on:
+    submissions sharing a bucket share one compiled search, so the
+    ledger (and the persistent jax cache) hit across tenants, and a
+    giant history can never inflate a small batchmate's padding."""
+    return bucket(n_ops, n_floor())
 
 
 def n_floor():

@@ -455,6 +455,26 @@ def _add_campaign_opts(parser, axes=False):
                             help="Bearer token /api requests must "
                                  "present (401 otherwise) when "
                                  "--serve is on.")
+        parser.add_argument("--no-coalesce", action="store_true",
+                            help="Disable cross-tenant batch "
+                                 "coalescing for --serve: every "
+                                 "accepted /api/check runs its own "
+                                 "device search instead of merging "
+                                 "with queued strangers (default: "
+                                 "coalescing on).")
+        parser.add_argument("--coalesce-window-ms", type=float,
+                            default=None, metavar="MS",
+                            help="How long a submitted check may wait "
+                                 "for batchmates before its device "
+                                 "batch closes anyway (default 25; "
+                                 "PL020 rejects non-positive "
+                                 "values).")
+        parser.add_argument("--coalesce-max-segments", type=int,
+                            default=None, metavar="N",
+                            help="Segments per coalesced device batch "
+                                 "past which the batch closes early "
+                                 "(default 32; PL020 rejects "
+                                 "non-positive values).")
         parser.add_argument("--worker-store", default=None,
                             metavar="DIR",
                             help="Store directory the fleet WORKERS "
@@ -596,6 +616,7 @@ _FLEET_LOCAL_OPTS = {
     "auth-token", "worker-store", "sync-timeout", "chaos-profile",
     "fleetlint", "no-ledger", "backends", "axis", "seeds", "parallel",
     "device-slots", "campaign-id", "resume", "lint?",
+    "no-coalesce", "coalesce-window-ms", "coalesce-max-segments",
 }
 
 
@@ -722,6 +743,17 @@ def campaign_cmd(opts):
         # way; the journal half runs inside run_fleet's resume path
         diags += analysis.planlint.lint_fleetlint(
             {"fleetlint": options.get("fleetlint")})
+        # cross-tenant coalescing preflight (PL020) rides the same
+        # way whenever the service would be co-launched
+        diags += analysis.planlint.lint_coalesce({
+            "coalesce?": bool(options.get("serve"))
+            and not options.get("no-coalesce"),
+            "coalesce-window-ms": options.get("coalesce-window-ms"),
+            "coalesce-max-segments":
+                options.get("coalesce-max-segments"),
+            "device-slots": options.get("device-slots"),
+            "engine": options.get("engine"),
+        })
         if options.get("lint?"):
             print(analysis.render_text(diags, title="campaign lint:"))
             for c in cells_plan:
@@ -735,7 +767,12 @@ def campaign_cmd(opts):
             from . import web
             web.serve({"ip": options.get("serve-ip", "0.0.0.0"),
                        "port": options.get("serve-port", 8080),
-                       "token": options.get("auth-token")})
+                       "token": options.get("auth-token"),
+                       "coalesce?": not options.get("no-coalesce"),
+                       "coalesce-window-ms":
+                           options.get("coalesce-window-ms"),
+                       "coalesce-max-segments":
+                           options.get("coalesce-max-segments")})
         if workers is not None:
             from . import fleet
             try:
@@ -759,7 +796,13 @@ def campaign_cmd(opts):
                     serve_ip=options.get("serve-ip"),
                     auth_token=options.get("auth-token"),
                     trace_merge=not options.get("no-trace-merge"),
-                    fleetlint=options.get("fleetlint") or "on")
+                    fleetlint=options.get("fleetlint") or "on",
+                    coalesce=bool(options.get("serve"))
+                    and not options.get("no-coalesce"),
+                    coalesce_window_ms=options.get(
+                        "coalesce-window-ms"),
+                    coalesce_max_segments=options.get(
+                        "coalesce-max-segments"))
             except fleet.FleetError as e:
                 raise CliError(str(e)) from e
             print(campaign.report.render_text(report))
@@ -822,6 +865,26 @@ def serve_cmd():
                             help="Bearer token /api requests must "
                                  "present (401 otherwise); PL016 "
                                  "demands one for non-loopback binds.")
+        parser.add_argument("--no-coalesce", action="store_true",
+                            help="Disable cross-tenant batch "
+                                 "coalescing: every accepted "
+                                 "/api/check runs its own device "
+                                 "search instead of merging with "
+                                 "queued strangers (default: "
+                                 "coalescing on).")
+        parser.add_argument("--coalesce-window-ms", type=float,
+                            default=None, metavar="MS",
+                            help="How long a submitted check may wait "
+                                 "for batchmates before its device "
+                                 "batch closes anyway (default 25; "
+                                 "PL020 rejects non-positive "
+                                 "values).")
+        parser.add_argument("--coalesce-max-segments", type=int,
+                            default=None, metavar="N",
+                            help="Segments per coalesced device batch "
+                                 "past which the batch closes early "
+                                 "(default 32; PL020 rejects "
+                                 "non-positive values).")
 
     def run_serve(options):
         from . import web
@@ -829,14 +892,25 @@ def serve_cmd():
         diags = planlint.lint_service({
             "serve?": True, "serve-ip": options.get("host"),
             "auth-token?": bool(options.get("token"))})
+        diags += planlint.lint_coalesce({
+            "coalesce?": not options.get("no-coalesce"),
+            "coalesce-window-ms": options.get("coalesce-window-ms"),
+            "coalesce-max-segments":
+                options.get("coalesce-max-segments")})
         if diags:
             print(render_text(diags, title="serve preflight:"))
         if errors(diags):
-            raise CliError("refusing to serve: bind 127.0.0.1 or "
-                           "pass --token")
+            raise CliError("refusing to serve: fix the preflight "
+                           "errors above (bind 127.0.0.1 / pass "
+                           "--token / fix the coalesce knobs)")
         web.serve({"ip": options.get("host", "0.0.0.0"),
                    "port": options.get("port", 8080),
-                   "token": options.get("token")})
+                   "token": options.get("token"),
+                   "coalesce?": not options.get("no-coalesce"),
+                   "coalesce-window-ms":
+                       options.get("coalesce-window-ms"),
+                   "coalesce-max-segments":
+                       options.get("coalesce-max-segments")})
         print(f"Listening on http://{options.get('host')}:"
               f"{options.get('port')}/")
         try:

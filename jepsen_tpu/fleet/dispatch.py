@@ -151,7 +151,8 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
               device_slots=1, probe=True, env=None, sync="auto",
               worker_store_dir=None, sync_timeout_s=None, chaos=None,
               serve_ip=None, auth_token=None, trace_merge=True,
-              fleetlint="on"):
+              fleetlint="on", coalesce=False, coalesce_window_ms=None,
+              coalesce_max_segments=None):
     """Run a campaign across worker hosts; returns the report dict
     (persisted as report.json, same shape as scheduler.run_cells).
 
@@ -159,8 +160,9 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     ``builder`` is the importable ``"pkg.module:fn"`` every worker
     rebuilds test maps with, fed ``base_options`` overlaid with each
     cell's params. ``serve``/``device_slots``/``serve_ip``/
-    ``auth_token`` participate only in the PL014/PL016 preflight (the
-    CLI co-launches the service).
+    ``auth_token`` and the ``coalesce*`` knobs participate only in
+    the PL014/PL016/PL020 preflight (the CLI co-launches the
+    service).
 
     **Artifact sync** (``sync``): ``"auto"`` mirrors each remote
     cell's run directory into the coordinator store over the scp
@@ -251,6 +253,16 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     # PL018 (knob half): an unknown --fleetlint value is an error
     # here, not a silently-skipped audit
     diags += planlint.lint_fleetlint({"fleetlint": fleetlint})
+    # PL020: cross-tenant coalescing knobs ride along like the other
+    # serve knobs (the CLI co-launches the service; bad windows and
+    # no-op configurations surface before any host is contacted)
+    diags += planlint.lint_coalesce({
+        "coalesce?": coalesce,
+        "coalesce-window-ms": coalesce_window_ms,
+        "coalesce-max-segments": coalesce_max_segments,
+        "device-slots": device_slots,
+        "engine": base_options.get("engine"),
+    })
     if diags:
         logger.warning("%s", render_text(diags,
                                          title="fleet preflight:"))

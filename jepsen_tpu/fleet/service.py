@@ -29,6 +29,27 @@ external traffic can POST work instead of running the harness locally:
   graceful drain on shutdown. Rejected or shed requests never touch
   in-flight work -- a 429 is bookkeeping, not an abort.
 
+* **Cross-tenant batch coalescing.** Admitted ``jax-wgl`` checks used
+  to dispatch one device search each, serializing behind the device
+  while strangers queued (``service.queue_wait_s`` is exactly that
+  wait). P-compositionality (arxiv 1504.00204) makes merging them
+  sound: independent histories check independently, so the
+  `Coalescer` holds each submission's planner-produced encoded
+  segments for a short window (default 25 ms) or until a size cap,
+  then feeds segments from DIFFERENT callers as one
+  ``keyshard.check_batch_encoded`` call. Batches group on
+  ``(model, op-count bucket)`` -- the same pow-2
+  ``jax_wgl._n_floor()`` buckets the campaign ledger keys on, so
+  shape-identical submissions from strangers hit one compiled search
+  (and the persistent jax cache) across tenants. Per-request wall
+  deadlines survive the merge: a segment whose request deadline
+  passes returns "unknown" to its owner without poisoning
+  batchmates, and ANY batcher failure falls back to the solo path
+  (verdict containment, the searchplan rule). The
+  ``service.coalesce.*`` metric family on ``/api/metrics`` carries
+  batches/segments/occupancy next to ``admission.shed_total``, so
+  the shed-vs-coalesce crossover under load is visible live.
+
 Transport-level hardening (size limits, JSON errors) lives in
 web.Handler; this module is pure request logic so it tests without a
 socket.
@@ -47,8 +68,11 @@ from .. import robust, store
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["MAX_BODY_BYTES", "ApiError", "Admission",
-           "DEFAULT_BUDGETS", "authorize", "admission", "configure",
+__all__ = ["MAX_BODY_BYTES", "ApiError", "Admission", "Coalescer",
+           "DEFAULT_BUDGETS", "DEFAULT_COALESCE_WINDOW_MS",
+           "DEFAULT_COALESCE_MAX_SEGMENTS",
+           "authorize", "admission", "configure",
+           "configure_coalesce", "coalescer",
            "check_history", "submit_campaign", "campaign_status",
            "latch", "drain", "shutdown", "reset",
            "register_metrics_source", "unregister_metrics_source",
@@ -319,10 +343,296 @@ class Admission:
             return {c: dict(st) for c, st in self._callers.items()}
 
 
+# ---------------------------------------------------------------------------
+# cross-tenant batch coalescing: queued /api/check segments from
+# different callers merge into one padded device batch
+
+#: how long the first segment of a batch may wait for batchmates
+#: before the batch closes anyway (milliseconds)
+DEFAULT_COALESCE_WINDOW_MS = 25.0
+
+#: segments per batch past which the batch closes early -- bounds both
+#: the device program's key axis and how much one batch failure costs
+DEFAULT_COALESCE_MAX_SEGMENTS = 32
+
+#: occupancy histogram buckets: real segments / pow-2 key lanes
+COALESCE_OCC_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                        0.875, 1.0)
+
+#: the result a segment's owner reads when its request deadline
+#: passes -- ONE shape for the coalesced, solo, and pre-encode budget
+#: checks, so verdict folding cannot tell the paths apart (no engine
+#: key: the same sentinel serves every engine's exhausted budget)
+_DEADLINE_RESULT = {"valid": "unknown",
+                    "error": "request timeout budget exhausted"}
+
+
+class _PendingSegment:
+    """One encoded segment waiting in (or delivered by) the batcher.
+    ``result`` is read only after ``event`` is set; ``None`` then
+    means "fall back to the solo path" (batcher failure / shutdown),
+    never a verdict."""
+
+    __slots__ = ("spec", "pair", "deadline", "owner", "enqueued",
+                 "event", "result")
+
+    def __init__(self, spec, pair, deadline, owner):
+        self.spec = spec
+        self.pair = pair
+        self.deadline = float(deadline)
+        self.owner = str(owner)
+        self.enqueued = time.monotonic()
+        self.event = threading.Event()
+        self.result = None
+
+
+class Coalescer:
+    """The cross-tenant batcher: a coalescing queue plus one daemon
+    thread that closes batches and drives the device.
+
+    * **Grouping.** Segments queue per ``(model, op-count bucket)`` --
+      the bucket from ``campaign.compile_cache.bucket_for`` (the same
+      pow-2 ``jax_wgl._n_floor()`` rule every engine pads with), so
+      one giant history can't inflate every batchmate's padding, and
+      shape-identical strangers land in ONE compiled search (the
+      compile ledger and the persistent jax cache hit across
+      tenants).
+    * **Closing.** A group's batch closes ``window_s`` after its
+      oldest segment enqueued, or immediately at ``max_segments``.
+      Batches dispatch on the batcher thread itself, so while one
+      batch runs the device, later submissions keep accumulating into
+      larger batches -- backpressure turns into occupancy.
+    * **Deadlines.** Each segment carries its request's wall
+      deadline. A segment already expired at dispatch is answered
+      "unknown" without touching the device; the batch's own device
+      budget is the LONGEST remaining deadline (capped), so a
+      short-deadline tenant times out alone -- `wait` returns its
+      "unknown" at its own deadline while batchmates keep running.
+    * **Containment.** Any dispatch failure (and shutdown) delivers
+      ``None`` to every waiting owner, which re-runs that segment on
+      the solo path -- a batcher bug can cost the batching win, never
+      a verdict (the searchplan fallback rule).
+    """
+
+    def __init__(self, window_s=DEFAULT_COALESCE_WINDOW_MS / 1000.0,
+                 max_segments=DEFAULT_COALESCE_MAX_SEGMENTS):
+        window_s = float(window_s)
+        max_segments = int(max_segments)
+        if window_s <= 0:
+            raise ValueError(f"coalesce window must be positive, "
+                             f"got {window_s!r}")
+        if max_segments <= 0:
+            raise ValueError(f"coalesce segment cap must be positive, "
+                             f"got {max_segments!r}")
+        self.window_s = window_s
+        self.max_segments = max_segments
+        self._cond = threading.Condition()
+        self._queues = {}       # (model, bucket) -> [_PendingSegment]
+        self._stopped = False
+        self._thread = None     # started lazily on first submit
+        self._batches = 0
+        self._segments = 0
+        self._lanes = 0
+        self._fallbacks = 0
+        self._expired = 0
+
+    # -- the request side ----------------------------------------------
+
+    def submit(self, spec, e, init_state, deadline, owner="local"):
+        """Enqueue one encoded segment; returns the pending handle to
+        `wait` on. Raises when the coalescer is stopped (the caller
+        then checks solo)."""
+        from ..campaign import compile_cache
+        key = (spec.name, compile_cache.bucket_for(len(e)))
+        item = _PendingSegment(spec, (e, init_state), deadline, owner)
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("coalescer is stopped")
+            self._queues.setdefault(key, []).append(item)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name="jepsen coalesce batcher")
+                self._thread.start()
+            self._cond.notify_all()
+        return item
+
+    def wait(self, item):
+        """Block until ``item``'s batch delivered or its request
+        deadline passed. Returns the engine result dict, the
+        deadline "unknown" (same dict the solo path's exhausted
+        budget produces), or None = fall back to the solo path."""
+        left = item.deadline - time.monotonic()
+        if left <= 0 or not item.event.wait(timeout=left):
+            return dict(_DEADLINE_RESULT)
+        return item.result
+
+    def stats(self):
+        """Lifetime batch counters (tests, the bench rung):
+        ``occupancy`` is real segments over pow-2 key lanes across
+        every dispatched batch."""
+        with self._cond:
+            return {"batches": self._batches,
+                    "segments": self._segments,
+                    "lanes": self._lanes,
+                    "fallbacks": self._fallbacks,
+                    "expired": self._expired,
+                    "queued": sum(len(q)
+                                  for q in self._queues.values()),
+                    "occupancy": round(self._segments / self._lanes, 4)
+                    if self._lanes else None}
+
+    # -- lifecycle ------------------------------------------------------
+
+    def stop(self, join_s=5.0):
+        """Stop accepting and wake every queued segment with the
+        solo-fallback sentinel; bounded join on the batcher thread."""
+        with self._cond:
+            self._stopped = True
+            pending = [it for q in self._queues.values() for it in q]
+            self._queues.clear()
+            t = self._thread
+            self._cond.notify_all()
+        self._fail(pending)
+        if t is not None:
+            t.join(timeout=join_s)
+
+    # -- the batcher thread --------------------------------------------
+
+    def _ripe_key(self, now):
+        """The ripe group with the OLDEST head segment (not dict
+        order: one continuously-busy group must not starve the
+        others on the single batcher thread)."""
+        best = None
+        best_age = -1.0
+        for key, q in self._queues.items():
+            if q and (len(q) >= self.max_segments
+                      or now - q[0].enqueued >= self.window_s):
+                age = now - q[0].enqueued
+                if age > best_age:
+                    best, best_age = key, age
+        return best
+
+    def _next_close(self, now):
+        return min(q[0].enqueued + self.window_s
+                   for q in self._queues.values() if q)
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._stopped \
+                        and not any(self._queues.values()):
+                    self._cond.wait()
+                if self._stopped:
+                    return
+                now = time.monotonic()
+                key = self._ripe_key(now)
+                if key is None:
+                    self._cond.wait(
+                        timeout=max(0.001, self._next_close(now) - now))
+                    continue
+                q = self._queues[key]
+                items = q[:self.max_segments]
+                rest = q[self.max_segments:]
+                if rest:
+                    self._queues[key] = rest
+                else:
+                    del self._queues[key]
+            try:
+                self._dispatch(items)
+            except Exception:  # noqa: BLE001 - thread must survive
+                logger.warning("coalesced batch dispatch crashed",
+                               exc_info=True)
+                self._fail(items)
+
+    def _fail(self, items):
+        """Deliver the solo-fallback sentinel to every still-waiting
+        member (containment: their owners re-check solo)."""
+        undelivered = [it for it in items if not it.event.is_set()]
+        if not undelivered:
+            return
+        with self._cond:
+            self._fallbacks += len(undelivered)
+        for it in undelivered:
+            it.result = None
+            it.event.set()
+        try:
+            slo_registry().inc("service.coalesce.fallbacks",
+                               len(undelivered))
+        except Exception:  # noqa: BLE001
+            logger.warning("coalesce accounting failed", exc_info=True)
+
+    def _dispatch(self, items):
+        spec = items[0].spec
+        now = time.monotonic()
+        live = []
+        for it in items:
+            if it.deadline <= now:
+                # expired while queued: its owner already read (or
+                # will read) the deadline "unknown" from wait();
+                # don't burn device work on it, don't let its corpse
+                # widen the batch
+                it.result = dict(_DEADLINE_RESULT)
+                it.event.set()
+            else:
+                live.append(it)
+        with self._cond:
+            self._expired += len(items) - len(live)
+        if not live:
+            return
+        # the batch's device budget serves its LONGEST deadline: a
+        # short-deadline member times out alone in wait(), batchmates
+        # keep their shot at a definite verdict
+        timeout_s = min(CHECK_TIMEOUT_CAP_S,
+                        max(it.deadline for it in live) - now)
+        try:
+            from ..parallel import keyshard
+            results = keyshard.check_batch_encoded(
+                spec, [it.pair for it in live], timeout_s=timeout_s,
+                owners=[it.owner for it in live])
+        except Exception:  # noqa: BLE001 - contained per batch
+            logger.warning("coalesced batch failed; %d segment(s) "
+                           "fall back to the solo path", len(live),
+                           exc_info=True)
+            self._fail(live)
+            return
+        for it, r in zip(live, results):
+            it.result = r
+            it.event.set()
+        lanes = 1 << (len(live) - 1).bit_length() if len(live) > 1 else 1
+        with self._cond:
+            self._batches += 1
+            self._segments += len(live)
+            self._lanes += lanes
+        self._note_batch(spec, live, lanes, now)
+
+    # -- accounting (never verdict-bearing) ----------------------------
+
+    def _note_batch(self, spec, live, lanes, t_dispatch):
+        try:
+            reg = slo_registry()
+            reg.inc("service.coalesce.batches", model=spec.name)
+            reg.inc("service.coalesce.segments", len(live),
+                    model=spec.name)
+            reg.observe("service.coalesce.occupancy",
+                        len(live) / lanes,
+                        buckets=COALESCE_OCC_BUCKETS)
+            reg.observe("service.coalesce.owners",
+                        len({it.owner for it in live}),
+                        buckets=(1, 2, 4, 8, 16, 32))
+            for it in live:
+                reg.observe("service.coalesce.wait_s",
+                            t_dispatch - it.enqueued,
+                            buckets=SLO_BUCKETS_S)
+        except Exception:  # noqa: BLE001
+            logger.warning("coalesce accounting failed", exc_info=True)
+
+
 _lock = threading.Lock()
 _latch = None
 _admission = None
 _campaigns = {}     # campaign id -> {"thread", "latch", "submitted"}
+_coalescer = None
 _slo = None
 
 
@@ -422,6 +732,39 @@ def admission():
         return _admission
 
 
+def configure_coalesce(enabled=True, window_ms=None, max_segments=None):
+    """(Re)build the service-wide cross-tenant batcher. ``enabled``
+    False tears it down (every check runs solo, the pre-coalescing
+    behavior); ``window_ms``/``max_segments`` default to the module
+    constants. Returns the new `Coalescer` (or None when disabled).
+    Replacing an existing coalescer stops it: its queued segments are
+    delivered the solo-fallback sentinel, so in-flight requests
+    complete correctly against the OLD configuration's containment
+    path rather than wedging."""
+    global _coalescer
+    new = None
+    if enabled:
+        w = DEFAULT_COALESCE_WINDOW_MS if window_ms is None \
+            else float(window_ms)
+        m = DEFAULT_COALESCE_MAX_SEGMENTS if max_segments is None \
+            else int(max_segments)
+        new = Coalescer(window_s=w / 1000.0, max_segments=m)
+    with _lock:
+        old = _coalescer
+        _coalescer = new
+    if old is not None:
+        old.stop()
+    return new
+
+
+def coalescer():
+    """The service-wide Coalescer, or None while coalescing is off
+    (the default for direct `check_history` callers; ``web.serve``
+    turns it on unless told otherwise)."""
+    with _lock:
+        return _coalescer
+
+
 def authorize(header=None, client="local"):
     """Module-level convenience: the caller id for one request, or
     401 (web.Handler calls this before routing)."""
@@ -452,6 +795,12 @@ def shutdown(reason="service-shutdown", join_s=10.0):
     latch().set(reason)
     with _lock:
         threads = [c["thread"] for c in _campaigns.values()]
+        coal = _coalescer
+    if coal is not None:
+        # after the drain: no new submissions arrive, and queued
+        # segments fall back to the solo path so in-flight requests
+        # still answer correctly while the server winds down
+        coal.stop()
     deadline = time.monotonic() + join_s
     for t in threads:
         t.join(timeout=max(0.1, deadline - time.monotonic()))
@@ -459,13 +808,17 @@ def shutdown(reason="service-shutdown", join_s=10.0):
 
 def reset():
     """Forget service state (tests)."""
-    global _latch, _admission, _slo
+    global _latch, _admission, _slo, _coalescer
     with _lock:
+        coal = _coalescer
         _latch = None
         _admission = None
         _slo = None
+        _coalescer = None
         _campaigns.clear()
         _metrics_sources.clear()
+    if coal is not None:
+        coal.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -608,13 +961,13 @@ def check_history(payload, caller="local"):
     with admission().check_slot(caller, ops=len(hist)):
         _slo_observe("service.queue_wait_s", time.monotonic() - t0,
                      endpoint="check")
-        out = _check_admitted(payload, hist)
+        out = _check_admitted(payload, hist, caller=caller)
     _slo_observe("service.verdict_latency_s", time.monotonic() - t0,
                  endpoint="check", valid=str(out.get("valid")))
     return out
 
 
-def _check_admitted(payload, hist):
+def _check_admitted(payload, hist, caller="local"):
     from ..analysis import histlint, errors as diag_errors
     from ..checker.checkers import Linearizable
     from ..models import model_spec
@@ -637,6 +990,15 @@ def _check_admitted(payload, hist):
                             f"got {timeout_s!r}")
     timeout_s = min(float(timeout_s or CHECK_TIMEOUT_S),
                     CHECK_TIMEOUT_CAP_S)
+    if not isinstance(payload.get("coalesce", True), bool):
+        raise ApiError(400, f"'coalesce' must be a boolean, got "
+                            f"{payload['coalesce']!r}")
+    # cross-tenant coalescing: only the device engine batches (the CPU
+    # engines have no key axis); the payload may opt a single request
+    # out ("coalesce": false), e.g. to compare against the solo path
+    coal = coalescer()
+    use_coal = (coal is not None and engine == "jax-wgl"
+                and payload.get("coalesce", True))
 
     # -- histlint: refuse malformed histories with the diagnostics ----
     diags = histlint.lint_history(hist, model_fs=set(spec.f_codes))
@@ -664,7 +1026,26 @@ def _check_admitted(payload, hist):
     plan_on = payload.get("searchplan", True)
     from ..analysis import searchplan
 
-    def check_one(sub):
+    def solo(e, init_state):
+        # the non-batched dispatch (and the containment target when
+        # the batcher fails a segment): the verdict survives, only
+        # the batching win is lost
+        left = deadline - time.monotonic()
+        if left <= 0:
+            return dict(_DEADLINE_RESULT)
+        engine_opts = {"timeout_s": left} \
+            if engine == "jax-wgl" else None
+        return mengine.check_prefix(spec, e, init_state,
+                                    engine=engine,
+                                    engine_opts=engine_opts)
+
+    def start_one(sub):
+        """Phase 1 of one (sub)history's check: plan, encode, and
+        SUBMIT every segment before anything waits -- all of this
+        request's segments (every key of a keyed submission, and
+        every concurrent stranger's) land in the same coalescing
+        window instead of paying one window per segment in sequence.
+        Returns the phase-2 closure that waits and folds."""
         client = lin.prepare_history(jhistory.client_ops(sub))
         segments = [client]
         plan_meta = None
@@ -682,34 +1063,74 @@ def _check_admitted(payload, hist):
                 # pairs re-encode per segment) or budget timing
                 n_ops = info["rows"] + info["elided"]
         per_seg = []
+        pending = []            # (slot, item, e, init_state)
         for seg in segments:
             left = deadline - time.monotonic()
             if left <= 0:
-                per_seg.append({"valid": "unknown",
-                                "error": "request timeout budget "
-                                         "exhausted"})
+                per_seg.append(dict(_DEADLINE_RESULT))
                 continue
-            engine_opts = {"timeout_s": left} if engine == "jax-wgl" \
-                else None
             e, init_state = spec.encode(seg)
             if n_ops is None:
                 n_ops = len(e)
-            per_seg.append(mengine.check_prefix(
-                spec, e, init_state, engine=engine,
-                engine_opts=engine_opts))
-        from ..checker.core import merge_valid
-        valid = merge_valid([r.get("valid") for r in per_seg])
-        errs = [str(r["error"]) for r in per_seg if r.get("error")]
-        return {"valid": valid, "ops": n_ops or 0,
-                **({"searchplan": plan_meta} if plan_meta else {}),
-                **({"error": errs[0]} if errs else {})}
+            if use_coal:
+                try:
+                    item = coal.submit(spec, e, init_state, deadline,
+                                       owner=caller)
+                except Exception:  # noqa: BLE001 - stopped/replaced
+                    logger.warning("coalescer submit failed; "
+                                   "checking solo", exc_info=True)
+                else:
+                    per_seg.append(None)
+                    pending.append((len(per_seg) - 1, item, e,
+                                    init_state))
+                    continue
+            per_seg.append(solo(e, init_state))
+
+        def finish():
+            for slot, item, e, init_state in pending:
+                r = coal.wait(item)
+                per_seg[slot] = r if r is not None \
+                    else solo(e, init_state)
+            # demux back into one per-(sub)history verdict through
+            # the same fold the planned offline paths use (worst-wins
+            # validity, configs sum, failing segment's witness
+            # carried)
+            merged = searchplan.merge_segment_results(
+                per_seg,
+                info={"cuts": plan_meta["cuts"],
+                      "elided": plan_meta["elided"]}
+                if plan_meta else None,
+                engine=engine)
+            errs = [str(r["error"]) for r in per_seg
+                    if r.get("error")]
+            out = {"valid": merged["valid"], "ops": n_ops or 0,
+                   "configs_explored": merged["configs_explored"],
+                   **({"searchplan": plan_meta} if plan_meta else {}),
+                   **({"error": errs[0]} if errs else {})}
+            # how many distinct tenants shared this submission's
+            # device batches (keyshard stamps batch_owners on
+            # searched keys)
+            owners = max((int(r.get("batch_owners") or 0)
+                          for r in per_seg), default=0)
+            if use_coal and owners:
+                out["coalesced"] = {"owners": owners}
+            return out
+
+        return finish
+
+    def check_one(sub):
+        return start_one(sub)()
 
     try:
         if payload.get("keyed"):
             from ..checker.core import merge_valid
-            per_key = {str(k): check_one(sub)
+            # start EVERY key before finishing any: all keys'
+            # segments share one coalescing window (and one device
+            # batch) instead of each key paying its own window
+            started = [(str(k), start_one(sub))
                        for k, sub in sorted(_split_keyed(hist).items(),
-                                            key=lambda kv: str(kv[0]))}
+                                            key=lambda kv: str(kv[0]))]
+            per_key = {k: finish() for k, finish in started}
             out = {"valid": merge_valid([r["valid"]
                                          for r in per_key.values()]),
                    "keys": per_key}

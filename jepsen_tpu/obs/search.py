@@ -102,12 +102,14 @@ class SearchObs:
         return self._tr is not None or self._reg is not None
 
     def plan(self, engine, n_bucket, rows_real, rows_total, keys=None,
-             lanes=None):
+             lanes=None, owners=None):
         """Record one search's padded-batch composition, once at
         entry: ``rows_real`` real op rows landed in a padded batch of
         ``rows_total`` rows (``lanes`` x ``n_bucket`` for the key
         batch). The per-bucket real/padded counters are what the
-        campaign fold renders as the padding-waste table."""
+        campaign fold renders as the padding-waste table. ``owners``
+        is the distinct-tenant count of a cross-tenant service batch
+        (keyshard passes it through; absent everywhere else)."""
         tr, reg = self._tr, self._reg
         if tr is None and reg is None:
             return
@@ -129,6 +131,8 @@ class SearchObs:
                 fields["keys"] = int(keys)
             if lanes is not None:
                 fields["lanes"] = int(lanes)
+            if owners is not None:
+                fields["owners"] = int(owners)
             tr.instant(f"wgl.plan.{engine}", cat="search", args=fields)
 
     def heartbeat(self, engine, iteration, chunk_s, frontier=None,

@@ -109,7 +109,8 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
                         chunk_iters=256, timeout_s=None, mesh=None,
                         frontier_width=None, stack_size=None,
                         table_size=None, checkpoint=None,
-                        checkpoint_every_s=60.0, rollout_seeds=None):
+                        checkpoint_every_s=60.0, rollout_seeds=None,
+                        owners=None):
     """Check many keys' histories at once.
 
     ``pairs`` is a list of (EncodedHistory, init_state). Returns a list of
@@ -117,6 +118,15 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     With ``mesh`` (a 1-D ``jax.sharding.Mesh``), keys shard over its first
     axis via shard_map; the batch is padded to a multiple of the axis size
     with dummy keys.
+
+    ``owners`` (optional, parallel to ``pairs``) labels each key with
+    the tenant that submitted it -- the fleet service's cross-tenant
+    coalescer passes caller ids here. Pure metadata: it never reaches
+    the device or the compile-ledger key (cross-tenant batches MUST
+    hit the shapes campaigns already compiled), but the distinct-owner
+    count of the searched keys lands in the padding-plan telemetry and
+    every searched key's result carries it as ``batch_owners``, so a
+    coalesced submission can see how many strangers shared its batch.
 
     ``checkpoint`` names a file the batch state is periodically
     snapshotted to (every ``checkpoint_every_s``, between chunks):
@@ -331,9 +341,11 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     # keys), so real rows = the live keys' actual op counts against
     # K * n_pad padded rows — the per-bucket waste the campaign fold
     # tables
+    n_owners = len({str(owners[k]) for k in live}) \
+        if owners is not None else None
     so.plan("jax-wgl-batch", n_pad,
             sum(len(pairs[k][0]) for k in live), K * n_pad,
-            keys=len(live), lanes=K)
+            keys=len(live), lanes=K, owners=n_owners)
     # adaptive dispatch quantum (jax_wgl._adapt_quantum, shared with
     # the single-key loop): calibrated from the measured per-iteration
     # wall. The batch targets ~1 s per dispatch (shorter than the
@@ -497,6 +509,8 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         # batch-wide diagnostic: how often stragglers were compacted
         # (and, under a mesh, resharded) during this run
         results[k]["compactions"] = n_compactions
+        if n_owners is not None:
+            results[k]["batch_owners"] = n_owners
     if so.enabled():
         so.summary(
             "jax-wgl-batch",

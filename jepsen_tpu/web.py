@@ -567,14 +567,23 @@ def serve(opts=None):
     (default 0.0.0.0), port (default 8080), plus the admission knobs
     -- token (Bearer token /api requests must present), budgets (a
     service.DEFAULT_BUDGETS overlay), queue-wait-s -- which configure
-    the service gate before the socket opens."""
+    the service gate before the socket opens, and the cross-tenant
+    coalescing knobs -- coalesce? (default True: queued ``jax-wgl``
+    /api/check submissions merge into one padded device batch),
+    coalesce-window-ms, coalesce-max-segments."""
+    from .fleet import service
     opts = opts or {}
-    if opts.get("token") or opts.get("budgets") \
-            or opts.get("queue-wait-s"):
-        from .fleet import service
+    qw = opts.get("queue-wait-s")
+    if opts.get("token") or opts.get("budgets") or qw is not None:
+        # NB ``qw or 15.0``, the old spelling, coerced a legal explicit
+        # 0 (shed immediately, never queue) back to the default
         service.configure(
             token=opts.get("token"), budgets=opts.get("budgets"),
-            queue_wait_s=opts.get("queue-wait-s") or 15.0)
+            queue_wait_s=15.0 if qw is None else qw)
+    service.configure_coalesce(
+        enabled=opts.get("coalesce?", True),
+        window_ms=opts.get("coalesce-window-ms"),
+        max_segments=opts.get("coalesce-max-segments"))
     addr = (opts.get("ip", "0.0.0.0"), opts.get("port", 8080))
     server = ThreadingHTTPServer(addr, Handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True,

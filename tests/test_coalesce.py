@@ -1,0 +1,391 @@
+"""Cross-tenant batch coalescing tests (fleet.service.Coalescer):
+verdict equivalence coalesced-vs-solo with valid AND invalid
+submissions mixed in one batch, per-request deadline isolation (a slow
+tenant's timeout can't flip or delay a batchmate's verdict),
+batcher-crash fallback containment, cross-tenant compile-ledger hits
+on shape-identical submissions, the /api/metrics coalesce family,
+planlint PL020, and the web.serve queue-wait-s=0 regression."""
+
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import store, web
+from jepsen_tpu.analysis import planlint
+from jepsen_tpu.campaign import compile_cache
+from jepsen_tpu.fleet import service
+from jepsen_tpu.parallel import keyshard
+
+
+@pytest.fixture(autouse=True)
+def service_state(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+    compile_cache.reset()
+    service.reset()
+    yield
+    service.reset()
+    compile_cache.reset()
+
+
+def burst_hist(bursts=2, stale_read=False):
+    """Concurrent write||write bursts + a final read: ambiguous enough
+    that no fast path decides it, so the submission really reaches the
+    device batch. ``stale_read`` reads a value that WAS written (so
+    invalidity needs the real search too, not the state
+    abstraction)."""
+    ev = []
+
+    def e(t, p, f, v):
+        ev.append({"type": t, "process": p, "f": f, "value": v})
+
+    for j in range(bursts):
+        x = j * 10
+        e("invoke", 0, "write", x)
+        e("invoke", 1, "write", x + 1)
+        e("ok", 0, "write", x)
+        e("ok", 1, "write", x + 1)
+        e("invoke", 0, "write", x + 5)
+        e("ok", 0, "write", x + 5)
+    e("invoke", 2, "read", None)
+    e("ok", 2, "read", 0 if stale_read else (bursts - 1) * 10 + 5)
+    return ev
+
+
+def concurrent_checks(payloads, callers):
+    """Fire the payloads concurrently (one thread each) so they land
+    inside one coalescing window; returns results in order."""
+    results = [None] * len(payloads)
+    errors = []
+
+    def call(i):
+        try:
+            results[i] = service.check_history(payloads[i],
+                                               caller=callers[i])
+        except Exception as exc:  # noqa: BLE001 - surfaced below
+            errors.append((i, exc))
+
+    threads = [threading.Thread(target=call, args=(i,))
+               for i in range(len(payloads))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not errors, errors
+    assert all(r is not None for r in results)
+    return results
+
+
+# ---------------------------------------------------------------------------
+# verdict equivalence: coalesced vs solo, mixed valid+invalid batches
+
+def test_coalesced_verdicts_match_solo_mixed_batch():
+    """THE equivalence gate: four tenants (two valid, two with a stale
+    read) submitted concurrently through the batcher must get exactly
+    the verdicts the solo path gives, and at least one batch must
+    really have merged strangers (owners >= 2)."""
+    payloads = [
+        {"history": burst_hist(2), "model": "cas-register"},
+        {"history": burst_hist(2, stale_read=True),
+         "model": "cas-register"},
+        {"history": burst_hist(3), "model": "cas-register"},
+        {"history": burst_hist(3, stale_read=True),
+         "model": "cas-register"},
+    ]
+    solo = [service.check_history({**p, "coalesce": False},
+                                  caller=f"solo-{i}")
+            for i, p in enumerate(payloads)]
+    service.configure_coalesce(enabled=True, window_ms=200)
+    coal = concurrent_checks(payloads,
+                             [f"tenant-{i}" for i in range(4)])
+    assert [r["valid"] for r in coal] == [r["valid"] for r in solo] \
+        == [True, False, True, False]
+    st = service.coalescer().stats()
+    assert st["batches"] >= 1 and st["segments"] >= 2
+    assert max(r.get("coalesced", {}).get("owners", 0)
+               for r in coal) >= 2
+
+
+def test_coalesced_keyed_and_register_model_match_solo():
+    """Keyed histories split per key; each key's segments ride the
+    same batcher. A different model (register) groups separately and
+    still answers correctly."""
+    keyed = []
+    for k, bad in (("a", False), ("b", True)):
+        for op in burst_hist(2, stale_read=bad):
+            op = dict(op)
+            op["value"] = [k, op["value"]]
+            keyed.append(op)
+    service.configure_coalesce(enabled=True, window_ms=100)
+    r = service.check_history({"history": keyed, "model": "register",
+                               "keyed": True}, caller="kt")
+    assert r["valid"] is False
+    assert r["keys"]["a"]["valid"] is True
+    assert r["keys"]["b"]["valid"] is False
+
+
+def test_cpu_engines_bypass_coalescer():
+    """Only jax-wgl submissions batch: the CPU engines take the solo
+    path untouched (PL020 calls coalescing with them a no-op)."""
+    service.configure_coalesce(enabled=True, window_ms=50)
+    for engine in ("wgl", "linear"):
+        r = service.check_history(
+            {"history": burst_hist(2, stale_read=True),
+             "model": "cas-register", "engine": engine},
+            caller=f"cpu-{engine}")
+        assert r["valid"] is False, engine
+    assert service.coalescer().stats()["batches"] == 0
+
+
+def test_payload_coalesce_opt_out_and_validation():
+    service.configure_coalesce(enabled=True, window_ms=50)
+    r = service.check_history({"history": burst_hist(2),
+                               "model": "cas-register",
+                               "coalesce": False}, caller="opt-out")
+    assert r["valid"] is True
+    assert service.coalescer().stats()["batches"] == 0
+    with pytest.raises(service.ApiError) as e:
+        service.check_history({"history": burst_hist(2),
+                               "coalesce": "yes"})
+    assert e.value.status == 400
+
+
+# ---------------------------------------------------------------------------
+# deadline isolation + containment
+
+def test_deadline_isolation_slow_tenant_cannot_poison_batchmate(
+        monkeypatch):
+    """A short-deadline tenant batched with a slow device call times
+    out ALONE ("unknown" at its own deadline); its batchmate's
+    definite verdict is neither flipped nor lost."""
+    real = keyshard.check_batch_encoded
+
+    def slow(spec, pairs, **kw):
+        time.sleep(0.6)
+        return real(spec, pairs, **kw)
+
+    monkeypatch.setattr(keyshard, "check_batch_encoded", slow)
+    service.configure_coalesce(enabled=True, window_ms=100)
+    out = concurrent_checks(
+        [{"history": burst_hist(2), "model": "cas-register",
+          "timeout-s": 0.2},
+         {"history": burst_hist(2, stale_read=True),
+          "model": "cas-register", "timeout-s": 60}],
+        ["hurried", "patient"])
+    assert out[0]["valid"] == "unknown"
+    assert "timeout" in out[0]["error"]
+    assert out[1]["valid"] is False
+
+
+def test_expired_segment_never_touches_the_device(monkeypatch):
+    """A segment whose deadline passed while queued is answered
+    "unknown" at dispatch without burning device work (and without
+    shrinking batchmates' verdicts)."""
+    calls = []
+    real = keyshard.check_batch_encoded
+
+    def spy(spec, pairs, **kw):
+        calls.append(len(pairs))
+        return real(spec, pairs, **kw)
+
+    monkeypatch.setattr(keyshard, "check_batch_encoded", spy)
+    # window far beyond the hurried tenant's deadline: it EXPIRES in
+    # the queue while the patient one keeps the batch alive
+    service.configure_coalesce(enabled=True, window_ms=400)
+    out = concurrent_checks(
+        [{"history": burst_hist(2), "model": "cas-register",
+          "searchplan": False, "timeout-s": 0.05},
+         {"history": burst_hist(2), "model": "cas-register",
+          "searchplan": False, "timeout-s": 60}],
+        ["hurried", "patient"])
+    assert out[0]["valid"] == "unknown"
+    assert out[1]["valid"] is True
+    assert calls == [1]     # only the patient tenant's segment ran
+    assert service.coalescer().stats()["expired"] == 1
+
+
+def test_batcher_crash_falls_back_to_solo_path(monkeypatch):
+    """Containment: a batcher that crashes outright costs the batching
+    win, never the verdict -- every member re-runs solo."""
+    def boom(spec, pairs, **kw):
+        raise RuntimeError("injected batcher fault")
+
+    monkeypatch.setattr(keyshard, "check_batch_encoded", boom)
+    service.configure_coalesce(enabled=True, window_ms=100)
+    out = concurrent_checks(
+        [{"history": burst_hist(2), "model": "cas-register"},
+         {"history": burst_hist(2, stale_read=True),
+          "model": "cas-register"}],
+        ["a", "b"])
+    assert [r["valid"] for r in out] == [True, False]
+    st = service.coalescer().stats()
+    assert st["fallbacks"] >= 2 and st["batches"] == 0
+    flat = service.slo_registry().snapshot()["counters"]
+    assert flat.get("service.coalesce.fallbacks", 0) >= 2
+
+
+def test_replacing_coalescer_releases_queued_segments():
+    """configure_coalesce over a live coalescer stops the old one; its
+    queued segments fall back solo instead of wedging the request."""
+    service.configure_coalesce(enabled=True, window_ms=30_000)
+    out = {}
+
+    def call():
+        out["r"] = service.check_history(
+            {"history": burst_hist(2), "model": "cas-register",
+             "searchplan": False}, caller="queued")
+
+    t = threading.Thread(target=call)
+    t.start()
+    time.sleep(0.3)             # let the segment enqueue
+    service.configure_coalesce(enabled=False)
+    t.join(timeout=120)
+    assert not t.is_alive()
+    assert out["r"]["valid"] is True
+
+
+# ---------------------------------------------------------------------------
+# batching mechanics + cross-tenant compile reuse
+
+def test_size_cap_closes_batch_before_window():
+    service.configure_coalesce(enabled=True, window_ms=30_000,
+                               max_segments=2)
+    t0 = time.monotonic()
+    out = concurrent_checks(
+        [{"history": burst_hist(2), "model": "cas-register",
+          "searchplan": False},
+         {"history": burst_hist(2), "model": "cas-register",
+          "searchplan": False}],
+        ["a", "b"])
+    assert [r["valid"] for r in out] == [True, True]
+    assert time.monotonic() - t0 < 30          # not the 30 s window
+    st = service.coalescer().stats()
+    assert st["batches"] == 1 and st["segments"] == 2
+    assert st["occupancy"] == 1.0
+
+
+def test_cross_tenant_ledger_hits_on_shape_identical_submissions():
+    """Two strangers' shape-identical submissions share one compiled
+    batch search: the first coalesced batch is the miss, the second
+    round's identical batch is a ledger HIT (the jit cache served the
+    compile across tenants)."""
+    service.configure_coalesce(enabled=True, window_ms=200)
+    payloads = [{"history": burst_hist(2), "model": "cas-register",
+                 "searchplan": False},
+                {"history": burst_hist(2, stale_read=True),
+                 "model": "cas-register", "searchplan": False}]
+    first = concurrent_checks(payloads, ["tenant-a", "tenant-b"])
+    assert [r["valid"] for r in first] == [True, False]
+    assert all(r["coalesced"]["owners"] == 2 for r in first)
+    before = compile_cache.stats()
+    second = concurrent_checks(payloads, ["tenant-c", "tenant-d"])
+    assert [r["valid"] for r in second] == [True, False]
+    after = compile_cache.stats()
+    assert after["hits"] > before["hits"]
+    assert after["misses"] == before["misses"]
+
+
+def test_coalesce_metrics_on_api_metrics():
+    """The shed-vs-coalesce crossover pair: service.coalesce.* renders
+    on /api/metrics next to admission.shed_total."""
+    service.configure_coalesce(enabled=True, window_ms=100)
+    concurrent_checks(
+        [{"history": burst_hist(2), "model": "cas-register",
+          "searchplan": False}] * 2,
+        ["m-a", "m-b"])
+    text = service.metrics_text()
+    assert "jepsen_service_coalesce_batches" in text
+    assert "jepsen_service_coalesce_segments" in text
+    assert "jepsen_service_coalesce_occupancy" in text
+    assert "jepsen_admission_shed_total" in text
+
+
+# ---------------------------------------------------------------------------
+# serve wiring + the queue-wait-s regression
+
+def test_serve_queue_wait_zero_is_not_coerced_to_default():
+    """Regression: ``opts.get("queue-wait-s") or 15.0`` coerced a
+    legal explicit 0 (shed immediately, never queue) back to 15.0."""
+    server = web.serve({"ip": "127.0.0.1", "port": 0,
+                        "queue-wait-s": 0,
+                        "budgets": {"concurrent-checks": 1}})
+    try:
+        assert service.admission().queue_wait_s == 0.0
+    finally:
+        server.shutdown()
+
+
+def test_serve_enables_coalescing_by_default_and_honors_opt_out():
+    server = web.serve({"ip": "127.0.0.1", "port": 0})
+    try:
+        assert service.coalescer() is not None
+    finally:
+        server.shutdown()
+    server = web.serve({"ip": "127.0.0.1", "port": 0,
+                        "coalesce?": False,
+                        "coalesce-window-ms": 5})
+    try:
+        assert service.coalescer() is None
+    finally:
+        server.shutdown()
+    server = web.serve({"ip": "127.0.0.1", "port": 0,
+                        "coalesce-window-ms": 7,
+                        "coalesce-max-segments": 3})
+    try:
+        coal = service.coalescer()
+        assert coal.window_s == pytest.approx(0.007)
+        assert coal.max_segments == 3
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# planlint PL020
+
+def test_pl020_bad_knobs_are_errors():
+    for cfg in ({"coalesce-window-ms": 0},
+                {"coalesce-window-ms": -5},
+                {"coalesce-window-ms": "fast"},
+                {"coalesce-max-segments": 0},
+                {"coalesce-max-segments": 2.5},
+                {"coalesce-max-segments": True}):
+        diags = planlint.lint_coalesce(cfg)
+        assert [d.code for d in diags] == ["PL020"], cfg
+        assert diags[0].severity == planlint.ERROR, cfg
+
+
+def test_pl020_noop_configurations_are_warnings():
+    diags = planlint.lint_coalesce({"coalesce?": True,
+                                    "device-slots": 0})
+    assert [d.code for d in diags] == ["PL020"]
+    assert diags[0].severity == planlint.WARNING
+    diags = planlint.lint_coalesce({"coalesce?": True,
+                                    "engine": "linear"})
+    assert [d.code for d in diags] == ["PL020"]
+    assert diags[0].severity == planlint.WARNING
+    # not enabled -> the no-op rules don't fire; jax-wgl is fine
+    assert planlint.lint_coalesce({"device-slots": 0}) == []
+    assert planlint.lint_coalesce({"coalesce?": True,
+                                   "engine": "jax-wgl",
+                                   "coalesce-window-ms": 25,
+                                   "coalesce-max-segments": 32,
+                                   "device-slots": 1}) == []
+
+
+def test_pl020_rides_run_fleet_preflight():
+    """A bad coalesce window refuses the fleet run exactly like the
+    other preflight errors (PL014-PL019)."""
+    from jepsen_tpu import fleet
+    with pytest.raises(fleet.FleetError) as e:
+        fleet.run_fleet([{"id": "c1", "group": {}, "params": {}}],
+                        ["local"], coalesce=True,
+                        coalesce_window_ms=0)
+    assert "PL020" in str(e.value) \
+        or "coalesce-window-ms" in str(e.value)
+
+
+def test_coalescer_rejects_bad_construction():
+    with pytest.raises(ValueError):
+        service.Coalescer(window_s=0)
+    with pytest.raises(ValueError):
+        service.Coalescer(max_segments=0)

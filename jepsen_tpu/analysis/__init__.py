@@ -21,6 +21,20 @@ and two renderers (`render_text` / `to_json`):
   ``checker.core.plan_history`` (opt out ``test["searchplan?"] =
   False``); consumed by the Linearizable/independent checkers, the
   streaming monitor, and the fleet check service.
+* **capplan** -- whole-campaign static capacity & shape planning:
+  predicts every compile shape, HBM footprint, and int32-wall
+  crossing from the campaign matrix x ModelSpecs before a single
+  device dispatch (CP001-CP008), persists byte-deterministic
+  ``capacity_plan.json``, and -- after the run -- diffs the
+  prediction against the compile ledger's actual keys (the
+  prediction oracle in ``report.json["capacity"]``). Wired as the
+  ``campaign --capacity plan|warn|enforce`` preflight,
+  ``--device-slots auto`` sizing, and the service coalescer's
+  bucket pre-registration.
+* **sizemodel** -- the ONE symbolic size model the analyzers share:
+  delegates to the live ``jax_wgl._plan_sizes`` /
+  ``compile_cache.bucket_for`` so jaxlint and capplan cannot drift
+  from the engines.
 * **codelint** -- AST thread-safety lint over the framework's own
   source, driven by ``tools/lint.py``.
 * **fleetlint** -- the control plane's own Jepsen: a post-hoc audit
@@ -35,8 +49,8 @@ and two renderers (`render_text` / `to_json`):
 See doc/analysis.md for the code catalogue.
 """
 
-from . import (codelint, fleetlint, fleetmodel,  # noqa: F401
-               histlint, jaxlint, planlint, searchplan)
+from . import (capplan, codelint, fleetlint, fleetmodel,  # noqa: F401
+               histlint, jaxlint, planlint, searchplan, sizemodel)
 from .diagnostics import (Diagnostic, ERROR, INFO,  # noqa: F401
                           SEVERITIES, WARNING, diag, errors,
                           max_severity, render_text, run_analyzer,
@@ -50,7 +64,7 @@ __all__ = [
     "errors", "warnings", "max_severity", "severity_counts",
     "render_text", "to_json", "run_analyzer",
     "histlint", "planlint", "jaxlint", "codelint", "searchplan",
-    "fleetlint", "fleetmodel",
+    "fleetlint", "fleetmodel", "capplan", "sizemodel",
     "lint_history", "lint_encoded", "lint_test_history",
     "lint_plan", "preflight", "PlanLintError",
 ]

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import sizemodel
 from .diagnostics import ERROR, WARNING, diag
 
 __all__ = ["lint_fn", "lint_jaxpr", "lint_model_spec",
@@ -54,8 +55,9 @@ HOST_CALLBACK_PRIMITIVES = frozenset({
     "host_callback_call", "outside_call", "debug_print",
 })
 
-#: cells (int32 lanes) addressable before device indices overflow
-INT32_CELL_LIMIT = 2 ** 31
+#: cells (int32 lanes) addressable before device indices overflow --
+#: defined once in analysis.sizemodel (capplan shares it)
+INT32_CELL_LIMIT = sizemodel.INT32_CELL_LIMIT
 
 #: captured constants larger than this many elements are flagged JX002
 CONST_ELEMENT_LIMIT = 1024
@@ -205,10 +207,12 @@ def lint_history_size(n, arg_width=1, keys=1, where="encoded-history"):
     cells (invoke/return/f/ok plus args+ret vectors) with int32 lane
     indices, and ``_encode_arrays`` re-ranks event indices into int32
     (two events per op). Beyond ~2^31 cells the flat gathers'
-    index arithmetic overflows."""
+    index arithmetic overflows. The cell math itself lives in
+    ``analysis.sizemodel`` (shared with capplan, so the two analyzers
+    cannot drift)."""
     diags = []
-    cells = int(keys) * int(n) * (2 * int(arg_width) + 4)
-    ranks = 2 * int(n)
+    cells = sizemodel.history_cells(n, arg_width, keys)
+    ranks = sizemodel.history_ranks(n)
     if cells >= INT32_CELL_LIMIT or ranks >= INT32_CELL_LIMIT:
         diags.append(diag(
             "JX004", ERROR,
@@ -234,9 +238,7 @@ def lint_searchplan_shapes(op_counts, max_shapes=MAX_PLAN_SHAPES,
     (``jax_wgl._bucket`` over the campaign-tunable ``_n_floor``), so
     the count is exactly the number of compiled search shapes the
     plan will demand along the n axis."""
-    from ..checker import jax_wgl
-    floor = jax_wgl._n_floor()
-    buckets = sorted({jax_wgl._bucket(max(1, int(n)), floor)
+    buckets = sorted({sizemodel.bucket_for(int(n))
                       for n in op_counts if int(n) > 0})
     if len(buckets) <= max_shapes:
         return []
@@ -256,15 +258,12 @@ def lint_search_plan(n, S, C=None, keys=1, arg_width=1,
                      where="search-plan"):
     """Lint the buffer plan jax_wgl would build for an n-op history:
     index-width conformance of the stack/table layouts plus the
-    history-size checks. Imports the checker lazily."""
-    from ..checker import jax_wgl
+    history-size checks. The buffer math is ``analysis.sizemodel``'s
+    (which delegates to the live ``jax_wgl._plan_sizes``)."""
     diags = lint_history_size(n, arg_width=arg_width, keys=keys,
                               where=where)
-    C = C if C is not None else max(1, min(n, 64))
-    B, W, O, T = jax_wgl._plan_sizes(n, S, C)
-    for label, cells in (("stack", keys * O * (B + S)),
-                         ("dedup table", T * 2),
-                         ("frontier step", keys * W * C * S)):
+    for label, cells in sizemodel.buffer_cells(n, S, C,
+                                               keys=keys).items():
         if cells >= INT32_CELL_LIMIT:
             diags.append(diag(
                 "JX004", ERROR,

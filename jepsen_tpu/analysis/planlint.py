@@ -93,6 +93,18 @@ Codes:
                  device engine has a key axis to batch on; everything
                  else takes the solo path and the knob is a no-op)
                  -- warnings
+  PL021 mixed    capacity planning (analysis/capplan.py): an unknown
+                 --capacity mode, a non-positive / non-numeric
+                 --device-mem-budget, --capacity enforce with no
+                 budget (HBM enforcement has nothing to enforce
+                 against), --device-slots auto with no budget (there
+                 is nothing to derive the slot count from), or an
+                 unreadable --capacity-plan file (serve) -- errors;
+                 enforce over a matrix with unknown-shape cells
+                 (enforcement only covers what the planner can see),
+                 or a --device-mem-budget with neither a --capacity
+                 mode nor --device-slots auto (the knob is ignored)
+                 -- warnings
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -112,7 +124,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["lint_plan", "lint_campaign", "lint_fleet", "lint_service",
            "lint_telemetry", "lint_fleetlint", "lint_introspection",
-           "lint_coalesce", "preflight",
+           "lint_coalesce", "lint_capacity", "preflight",
            "PlanLintError", "FATAL_CODES", "FLEETLINT_MODES",
            "monitor_diags", "searchplan_diags"]
 
@@ -811,6 +823,92 @@ def lint_coalesce(cfg):
                 "engines have no key axis), so every check takes the "
                 "solo path and the knob is a no-op",
                 "service.coalesce"))
+    return diags
+
+
+def lint_capacity(cfg):
+    """PL021: capacity-planning preflight (analysis/capplan.py),
+    before any plan is built or cell run. Recognized keys:
+    ``capacity`` (the --capacity mode), ``device-mem-budget``
+    (bytes), ``device-slots`` (an int or the literal "auto"),
+    ``unknown-cells`` (how many cells the built plan could not model,
+    for the enforce warning), and ``capacity-plan-file`` (a persisted
+    capacity_plan.json path the serve subcommand pre-registers
+    coalescer buckets from)."""
+    diags = []
+    cfg = cfg or {}
+    mode = cfg.get("capacity")
+    if mode is not None:
+        from .capplan import CAPACITY_MODES
+        if str(mode) not in CAPACITY_MODES:
+            diags.append(diag(
+                "PL021", ERROR,
+                f"unknown --capacity mode {mode!r}: known modes are "
+                f"{list(CAPACITY_MODES)}",
+                "capacity.mode",
+                "'plan' persists capacity_plan.json, 'warn' also "
+                "prints the table, 'enforce' refuses on CP/PL021 "
+                "errors"))
+            mode = None
+    budget = cfg.get("device-mem-budget")
+    if budget is not None and (not isinstance(budget, (int, float))
+                               or isinstance(budget, bool)
+                               or budget <= 0):
+        diags.append(diag(
+            "PL021", ERROR,
+            f"--device-mem-budget must be a positive byte count, got "
+            f"{budget!r}",
+            "capacity.device-mem-budget",
+            "pass the device's usable HBM in bytes (suffixes K/M/G "
+            "accepted on the CLI)"))
+        budget = None
+    slots = cfg.get("device-slots")
+    slots_auto = isinstance(slots, str) and slots.strip() == "auto"
+    if str(mode) == "enforce" and budget is None:
+        diags.append(diag(
+            "PL021", ERROR,
+            "--capacity enforce with no --device-mem-budget: the HBM "
+            "half of enforcement has nothing to enforce against",
+            "capacity.device-mem-budget",
+            "pass --device-mem-budget, or use --capacity warn"))
+    if slots_auto and budget is None:
+        diags.append(diag(
+            "PL021", ERROR,
+            "--device-slots auto with no --device-mem-budget: the "
+            "slot count derives from budget // peak cell footprint",
+            "capacity.device-slots",
+            "pass --device-mem-budget alongside --device-slots auto"))
+    if budget is not None and mode is None and not slots_auto:
+        diags.append(diag(
+            "PL021", WARNING,
+            "--device-mem-budget is set but no --capacity mode (or "
+            "--device-slots auto) consumes it: the knob is ignored",
+            "capacity.device-mem-budget",
+            "pass --capacity plan|warn|enforce, or drop the budget"))
+    unknown = cfg.get("unknown-cells")
+    if str(mode) == "enforce" and isinstance(unknown, int) \
+            and not isinstance(unknown, bool) and unknown > 0:
+        diags.append(diag(
+            "PL021", WARNING,
+            f"--capacity enforce over a matrix with {unknown} "
+            "unknown-shape cell(s): enforcement only covers the cells "
+            "the planner can see",
+            "capacity.enforce",
+            "register shape models (capplan.register_shapes) for the "
+            "unknown workloads, or use --capacity warn"))
+    pf = cfg.get("capacity-plan-file")
+    if pf is not None:
+        from .capplan import load_plan
+        if load_plan(str(pf)) is None:
+            diags.append(diag(
+                "PL021", ERROR,
+                f"--capacity-plan {pf!r} is not a readable "
+                "capacity_plan.json: there are no planned buckets to "
+                "pre-register",
+                "capacity.plan-file",
+                "point it at a capacity_plan.json produced by "
+                "`campaign --capacity plan` or `tools/lint.py "
+                "--matrix`"))
     return diags
 
 

@@ -1,0 +1,223 @@
+"""sizemodel: the ONE symbolic size model for the device WGL search.
+
+Every tensor the device search allocates is a pure function of a few
+integers -- op count ``n``, state width ``S``, point concurrency ``C``,
+arg width ``A``, key count -- determined *before anything runs*. Three
+consumers used to re-derive pieces of that math independently:
+
+* the engines themselves (``jax_wgl._plan_sizes`` + ``_bucket``, the
+  ground truth -- what actually allocates),
+* jaxlint's JX004-JX006 int32-wall checks (which re-stated the cell
+  arithmetic by hand),
+* and now capplan, the whole-campaign capacity planner.
+
+Independent restatements of one formula are drift waiting to happen: a
+cap change in ``_plan_sizes`` would silently invalidate every analyzer
+built on the old numbers. This module is the single shared face --
+``plan_sizes``/``bucket_for`` DELEGATE to the live engine/campaign
+implementations (no formula is copied), and the derived quantities
+(int32 cell counts, HBM byte footprints, ledger-key projections) are
+defined here exactly once. jaxlint and capplan both import from here;
+tests/test_capplan.py pins the delegation against the live engine.
+
+Deliberately dependency-light: jax_wgl and compile_cache are imported
+lazily from inside the functions, so the analyzer surface still loads
+in jax-free tooling contexts (the jaxlint rule).
+"""
+
+from __future__ import annotations
+
+__all__ = ["INT32_CELL_LIMIT", "BYTES_PER_CELL", "bucket", "n_floor",
+           "bucket_for", "plan_sizes", "history_cells", "history_ranks",
+           "buffer_cells", "int32_wall", "hbm_bytes", "search_shape",
+           "ledger_key_shape"]
+
+#: cells (int32 lanes) addressable before device indices overflow --
+#: the wall the packed-encoding roadmap item exists to break
+INT32_CELL_LIMIT = 2 ** 31
+
+#: every search lane is an int32/uint32: 4 bytes per cell
+BYTES_PER_CELL = 4
+
+
+# ---------------------------------------------------------------------------
+# delegation: the live implementations, not restatements
+
+def bucket(x, lo=1):
+    """Round up to a power of two (>= lo): the shared shape-bucket
+    rule. Delegates to campaign.compile_cache (itself the campaign
+    face of ``jax_wgl._bucket``)."""
+    from ..campaign import compile_cache
+    return compile_cache.bucket(x, lo)
+
+
+def n_floor():
+    """The CURRENT campaign-tunable minimum op-count bucket."""
+    from ..campaign import compile_cache
+    return compile_cache.n_floor()
+
+
+def bucket_for(n_ops):
+    """The op-count bucket an ``n_ops``-row encoded history pads to
+    under the current floor -- the grouping key every engine, the
+    service coalescer, and capplan's predictions share."""
+    from ..campaign import compile_cache
+    return compile_cache.bucket_for(n_ops)
+
+
+def plan_sizes(n, S, C, frontier_width=None, stack_size=None,
+               table_size=None):
+    """``(B, W, O, T)`` for an ``n``-op, ``S``-state, ``C``-concurrency
+    search: the bitmask word count, frontier width, stack depth, and
+    dedup-table size the engine will actually allocate. Delegates to
+    ``jax_wgl._plan_sizes`` -- THE size model; nothing here may fork
+    it."""
+    from ..checker import jax_wgl
+    return jax_wgl._plan_sizes(n, S, C, frontier_width, stack_size,
+                               table_size)
+
+
+# ---------------------------------------------------------------------------
+# derived quantities, defined exactly once
+
+def history_cells(n, arg_width=1, keys=1):
+    """int32 cells one encoded history occupies on device:
+    ``keys * n * (2*A + 4)`` (invoke/return/f/ok lanes plus the args
+    and ret vectors) -- the JX004/JX005 numerator."""
+    return int(keys) * int(n) * (2 * int(arg_width) + 4)
+
+
+def history_ranks(n):
+    """Event ranks ``_encode_arrays`` re-ranks into int32: two events
+    (invoke + return) per op."""
+    return 2 * int(n)
+
+
+def buffer_cells(n, S, C=None, keys=1, sizes=None):
+    """int32 cells per search buffer for an n-op plan:
+    ``{"stack", "dedup table", "frontier step"}`` -- the buffers whose
+    flat index arithmetic overflows first (jaxlint's JX004 buffer
+    checks read these labels verbatim). ``sizes`` may pass a
+    pre-computed ``(B, W, O, T)``."""
+    C = C if C is not None else max(1, min(int(n), 64))
+    B, W, O, T = sizes if sizes is not None else plan_sizes(n, S, C)
+    keys = int(keys)
+    return {
+        "stack": keys * O * (B + S),
+        "dedup table": T * 2,
+        "frontier step": keys * W * C * S,
+    }
+
+
+def int32_wall(n, arg_width=1, keys=1, S=None, C=None):
+    """Proximity to the int32 index wall for one search plan:
+    ``{"cells", "which", "frac"}`` where ``cells`` is the largest
+    int32-indexed extent (encoded history, event ranks, and -- when
+    ``S`` is given -- the search buffers) and ``frac`` is its fraction
+    of the 2^31 limit. ``frac >= 1.0`` is the JX004/CP008 overflow,
+    ``>= 0.5`` the JX005/CP007 proximity warning."""
+    extents = {"encoded history": history_cells(n, arg_width, keys),
+               "event ranks": history_ranks(n)}
+    if S is not None:
+        extents.update(buffer_cells(n, S, C, keys=keys))
+    which = max(extents, key=lambda k: extents[k])
+    cells = extents[which]
+    return {"cells": cells, "which": which,
+            "frac": round(cells / INT32_CELL_LIMIT, 6)}
+
+
+def hbm_bytes(n, S, C=None, keys=1, arg_width=1, sizes=None):
+    """Per-engine HBM footprint estimate (bytes) for one padded
+    search: the persistent per-key stores from ``_build_search``'s
+    carry layout (stack buf_lin/buf_state/buf_fp, the shared dedup
+    table, TOPK witness slots), the transient (W, C, S) model-step
+    tensor, and the encoded history itself. An upper-bound planning
+    number, not an allocator trace -- capplan compares it against
+    ``--device-mem-budget`` to size device slots.
+
+    NB ``keys`` defaults to 1 -- ONE padded key lane. The batched
+    engine's real allocation scales with its pow-2 runtime key axis
+    (how many keys a window batches), which is time-limit-bound and
+    not statically derivable; capplan's plans carry this caveat in
+    their ``hbm.note`` field."""
+    C = C if C is not None else max(1, min(int(n), 64))
+    B, W, O, T = sizes if sizes is not None else plan_sizes(n, S, C)
+    keys = int(keys)
+    per = BYTES_PER_CELL
+    out = {
+        # buf_lin (O,B) + buf_state (O,S) + buf_fp (O,2), per key
+        "stack": keys * O * (B + S + 2) * per,
+        # tab (T,2) fingerprint pairs, shared across the key axis
+        "dedup": T * 2 * per,
+        # the (W, C, S) frontier expansion step tensor, per key
+        "frontier": keys * W * C * S * per,
+        # best_depth/best_lin/best_state TOPK witness slots, per key
+        "witness": keys * 8 * (1 + B + S) * per,
+        # inv/ret/f/ok + args/ret vectors, per key
+        "encoded": history_cells(n, arg_width, keys) * per,
+    }
+    out["total"] = sum(out.values())
+    return out
+
+
+def search_shape(model, n_ops, *, keys=1, concurrency=None,
+                 engine="jax-wgl-batch"):
+    """The full symbolic prediction for one device search of a
+    ``model`` history with ``n_ops`` encoded rows (per key): padded
+    bucket, plan sizes, HBM footprint, int32-wall proximity. This is
+    capplan's per-cell unit. ``concurrency`` bounds the point
+    concurrency C (upper bound: real C is the measured overlap, never
+    larger); ``keys`` scales the per-key buffers.
+
+    Raises (KeyError on an unknown model, TypeError/ValueError on a
+    history-dependent state size) rather than guessing -- capplan
+    turns that into an unknown-shape cell (CP001)."""
+    from ..models import model_spec
+    spec = model_spec(model)
+    n_ops = int(n_ops)
+    n_pad = bucket_for(max(1, n_ops))
+    # history-dependent state sizes (queues: capacity = #enqueues)
+    # cannot be derived without the history; let the TypeError out
+    S = int(spec.state_size(None))
+    if spec.pad_state is not None:
+        S = bucket(S, 2)
+    C = min(bucket(max(1, int(concurrency or 4)), 4), n_pad)
+    A = int(spec.arg_width)
+    B, W, O, T = plan_sizes(n_pad, S, C)
+    return {
+        "model": spec.name,
+        "engine": str(engine),
+        "n_ops": n_ops,
+        "bucket": n_pad,
+        "S": S, "C": C, "A": A,
+        "sizes": {"B": B, "W": W, "O": O, "T": T},
+        "hbm": hbm_bytes(n_pad, S, C, keys=keys, arg_width=A,
+                         sizes=(B, W, O, T)),
+        "int32": int32_wall(n_pad, arg_width=A, keys=keys, S=S, C=C),
+    }
+
+
+# ---------------------------------------------------------------------------
+# ledger-key projection: what the engines actually noted
+
+#: where (model, n_pad) live in each engine's compile-plan key --
+#: mirrors the ``_note_compile`` call sites (jax_wgl.check_encoded:
+#: (spec.name, n_pad, B, S, C, A, W, O, T, ...); keyshard
+#: check_batch_encoded: (spec.name, K, W, n_pad, B, S_pad, C, A, ...)).
+#: tests/test_capplan.py pins this against a live run, so a key-layout
+#: change there fails here instead of silently skewing the oracle.
+_LEDGER_KEY_BUCKET_INDEX = {"jax-wgl": 1, "jax-wgl-batch": 3}
+
+
+def ledger_key_shape(engine, key):
+    """Project one compile-ledger key to ``(model, bucket)`` -- the
+    shape capplan predicts -- or None for engines the planner does not
+    model. ``key`` is the canonicalized key tuple/list the ledger
+    stores (model name first)."""
+    idx = _LEDGER_KEY_BUCKET_INDEX.get(str(engine))
+    if idx is None:
+        return None
+    try:
+        return (str(key[0]), int(key[idx]))
+    except (IndexError, TypeError, ValueError):
+        return None

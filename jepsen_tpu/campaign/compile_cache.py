@@ -45,7 +45,7 @@ import threading
 from .. import obs
 
 __all__ = ["bucket", "bucket_for", "note", "stats", "reset", "n_floor",
-           "set_n_floor",
+           "set_n_floor", "noted_keys",
            "bucket_floor", "DEFAULT_N_FLOOR", "set_ledger", "get_ledger"]
 
 #: default minimum op-count bucket (matches jax_wgl's historical 64)
@@ -53,6 +53,11 @@ DEFAULT_N_FLOOR = 64
 
 _lock = threading.Lock()
 _seen: set = set()
+_noted: set = set()       # keys THIS process actually noted (hit or
+#                           miss) -- unlike _seen, never pre-seeded by
+#                           a ledger attach, so a before/after bracket
+#                           yields exactly one campaign's real shapes
+#                           (capplan's prediction oracle)
 _hits: dict = {}          # engine -> int
 _misses: dict = {}        # engine -> int
 _n_floor = DEFAULT_N_FLOOR
@@ -162,6 +167,7 @@ def note(engine, key):
         _refresh_from(led)
     with _lock:
         hit = k in _seen
+        _noted.add(k)
         if hit:
             _hits[engine] = _hits.get(engine, 0) + 1
         else:
@@ -172,6 +178,16 @@ def note(engine, key):
     obs.inc("campaign.compile_cache.hits" if hit
             else "campaign.compile_cache.misses", engine=str(engine))
     return hit
+
+
+def noted_keys():
+    """Canonical ``(engine, key)`` pairs every search THIS process has
+    noted (hits and misses alike; never pre-seeded from a ledger
+    attach). The campaign scheduler brackets a run with this and diffs
+    the delta against capplan's predicted shapes -- the prediction
+    oracle's "actual" side for in-process campaigns."""
+    with _lock:
+        return set(_noted)
 
 
 def stats():
@@ -206,6 +222,7 @@ def reset():
     global _ledger
     with _lock:
         _seen.clear()
+        _noted.clear()
         _hits.clear()
         _misses.clear()
         _ledger = None

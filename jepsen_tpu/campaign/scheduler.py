@@ -126,7 +126,7 @@ def _outcome_of(test, latch):
 
 def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
               resume=False, latch=None, run_fn=None, ledger=True,
-              backends=None, fleetlint=True):
+              backends=None, fleetlint=True, capacity_plan=None):
     """Run a campaign; returns the aggregated report dict (also
     persisted as report.json in the campaign directory).
 
@@ -143,7 +143,17 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
     per-cell backend failover: before each cell runs, the healthiest
     tier is chosen and applied (a dead accelerator degrades the cell
     to the CPU oracle instead of crashing it); the chosen tier is
-    journaled on the cell record."""
+    journaled on the cell record.
+
+    ``capacity_plan`` (an analysis.capplan plan dict, built by the
+    CLI from the matrix + base options) is persisted as
+    ``capacity_plan.json`` in the campaign directory, and at finalize
+    the plan's predicted (model, bucket) shapes are diffed against
+    the compile shapes this campaign actually noted
+    (``compile_cache.noted_keys`` bracket) into
+    ``report["capacity"]`` -- the prediction oracle. CONTAINED both
+    ends: a crashing planner/oracle never changes a cell outcome or
+    the campaign exit code (the searchplan rule)."""
     cells = list(cells)
     ids = [c["id"] for c in cells]
     if len(set(ids)) != len(ids):
@@ -234,6 +244,20 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
         from ..fleet import backends as fbackends
         backends = fbackends.as_failover(backends)
     cc_before = compile_cache.stats()
+    cap_before = None
+    if capacity_plan is not None:
+        # persist the plan next to the journal and open the oracle
+        # bracket; contained -- the plan is advisory, never a gate
+        try:
+            from ..analysis import capplan
+            capplan.dump_plan(
+                capacity_plan,
+                store.campaign_path(campaign_id, capplan.PLAN_FILE))
+            cap_before = compile_cache.noted_keys()
+        except Exception:  # noqa: BLE001 - planning is advisory
+            logger.warning("couldn't persist the capacity plan "
+                           "(contained)", exc_info=True)
+            capacity_plan = None
     pending = [c for c in cells if c["id"] not in done]
     reg.set_gauge("campaign.cells_total", len(cells))
     reg.set_gauge("campaign.cells_resumed", len(done))
@@ -404,6 +428,23 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
         jr.write_meta({**(jr.load_meta() or {}),
                        "status": "aborted" if aborted else "complete",
                        "updated": store.local_time()})
+        if capacity_plan is not None:
+            # the prediction oracle: predicted (model, bucket) shapes
+            # vs the compile shapes this campaign actually noted.
+            # CONTAINED -- a crashing oracle costs the report block,
+            # never an outcome or the exit code
+            try:
+                from ..analysis import capplan
+                actual = compile_cache.noted_keys() \
+                    - (cap_before or set())
+                report["capacity"] = capplan.report_section(
+                    capacity_plan, actual,
+                    path=store.campaign_path(campaign_id,
+                                             capplan.PLAN_FILE))
+                jr.write_report(report)
+            except Exception:  # noqa: BLE001 - oracle is contained
+                logger.warning("capacity oracle crashed (contained)",
+                               exc_info=True)
         if fleetlint:
             try:
                 # control-plane audit (analysis.fleetlint): scheduler

@@ -388,14 +388,49 @@ def campaign_exit_code(report):
     return code
 
 
+def _parse_device_slots(value):
+    """--device-slots: a positive integer, or the literal "auto" (the
+    campaign subcommand derives the count from the capacity plan's
+    HBM footprints vs --device-mem-budget)."""
+    v = str(value).strip()
+    if v == "auto":
+        return "auto"
+    try:
+        return int(v)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--device-slots {value!r} should be an integer or "
+            "'auto'") from None
+
+
+def parse_bytes(value):
+    """A byte count with optional K/M/G/T suffix ("16G" -> 2**34)."""
+    s = str(value).strip()
+    mult = 1
+    suffixes = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30, "T": 1 << 40}
+    if s and s[-1].upper() in suffixes:
+        mult = suffixes[s[-1].upper()]
+        s = s[:-1]
+    try:
+        return int(float(s) * mult)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"byte count {value!r} should be a number with an "
+            "optional K/M/G/T suffix") from None
+
+
 def _add_campaign_opts(parser, axes=False):
     parser.add_argument("--parallel", type=int, default=1, metavar="N",
                         help="Worker-pool width: how many test cells "
                              "run concurrently (campaign scheduler).")
-    parser.add_argument("--device-slots", type=int, default=1,
-                        metavar="N",
+    parser.add_argument("--device-slots", type=_parse_device_slots,
+                        default=1, metavar="N",
                         help="How many device checker searches may run "
-                             "at once (one per accelerator).")
+                             "at once (one per accelerator), or "
+                             "'auto' to derive the count from the "
+                             "capacity plan (requires "
+                             "--device-mem-budget; campaign "
+                             "subcommand only).")
     parser.add_argument("--campaign-id", default=None, metavar="ID",
                         help="Campaign id (store/campaigns/<id>/); "
                              "default: derived from the start time.")
@@ -528,6 +563,26 @@ def _add_campaign_opts(parser, axes=False):
                             metavar="N",
                             help="Shorthand for --axis "
                                  "seed=0,1,...,N-1.")
+        parser.add_argument("--capacity", default=None, metavar="MODE",
+                            help="Static capacity preflight "
+                                 "(analysis.capplan): 'plan' persists "
+                                 "capacity_plan.json (predicted "
+                                 "compile shapes, HBM footprints, "
+                                 "int32-wall proximity) and runs the "
+                                 "prediction oracle at finalize; "
+                                 "'warn' also prints the table + "
+                                 "CP diagnostics; 'enforce' refuses "
+                                 "the campaign on CP/PL021 errors. "
+                                 "plan/warn can never change an "
+                                 "outcome or exit code.")
+        parser.add_argument("--device-mem-budget", type=parse_bytes,
+                            default=None, metavar="BYTES",
+                            help="Usable device HBM in bytes "
+                                 "(suffixes K/M/G/T accepted, e.g. "
+                                 "16G): capplan checks per-cell "
+                                 "footprints against it (CP004/"
+                                 "CP005) and --device-slots auto "
+                                 "derives from it.")
 
 
 def test_all_cmd(opts):
@@ -546,6 +601,10 @@ def test_all_cmd(opts):
             opts["opt-spec"](parser)
 
     def run_all(options):
+        if options.get("device-slots") == "auto":
+            raise CliError("--device-slots auto derives from a "
+                           "capacity plan over a sweep matrix; use "
+                           "the campaign subcommand")
         # ANY campaign flag routes through the scheduler -- a
         # --campaign-id or --device-slots on the legacy sequential path
         # would be silently ignored (no journal, nothing to resume)
@@ -617,6 +676,7 @@ _FLEET_LOCAL_OPTS = {
     "fleetlint", "no-ledger", "backends", "axis", "seeds", "parallel",
     "device-slots", "campaign-id", "resume", "lint?",
     "no-coalesce", "coalesce-window-ms", "coalesce-max-segments",
+    "capacity", "device-mem-budget",
 }
 
 
@@ -694,7 +754,12 @@ def campaign_cmd(opts):
         fleet_cfg = {
             "lease-s": options.get("lease"),
             "serve?": bool(options.get("serve")),
-            "device-slots": options.get("device-slots"),
+            # "auto" resolves AFTER the capacity preflight below;
+            # PL021 owns its validation, so PL014's integer rule
+            # must not see the placeholder
+            "device-slots": None
+            if options.get("device-slots") == "auto"
+            else options.get("device-slots"),
             "backends": [t.strip() for t in
                          str(options["backends"]).split(",")
                          if t.strip()]
@@ -754,15 +819,64 @@ def campaign_cmd(opts):
             "device-slots": options.get("device-slots"),
             "engine": options.get("engine"),
         })
+        # capacity preflight (PL021 + CP001-CP008, analysis.capplan):
+        # the whole-campaign static plan -- every compile shape, HBM
+        # footprint, and int32-wall crossing predicted from the
+        # matrix x ModelSpecs before anything runs. plan/warn are
+        # contained (their findings never gate the run); only enforce
+        # may refuse, and only on error diagnostics
+        capacity = options.get("capacity")
+        budget = options.get("device-mem-budget")
+        slots = options.get("device-slots")
+        cap_plan, cap_diags = None, []
+        if capacity is not None or budget is not None \
+                or slots == "auto":
+            from .analysis import capplan
+            try:
+                cap_plan, cap_diags = capplan.preflight(
+                    cells_plan, base=options, mode=capacity,
+                    device_mem_budget=budget, device_slots=slots)
+            except capplan.CapacityError as e:
+                if not options.get("lint?"):
+                    raise CliError(str(e)) from None
+                # --lint reports the refusal instead of raising past
+                # the lint output
+                cap_diags = e.diagnostics
         if options.get("lint?"):
-            print(analysis.render_text(diags, title="campaign lint:"))
+            print(analysis.render_text(diags + cap_diags,
+                                       title="campaign lint:"))
+            if cap_plan is not None:
+                from .analysis import capplan
+                print(capplan.render_table(cap_plan))
             for c in cells_plan:
                 print(c["id"])
-            sys.exit(1 if analysis.errors(diags) else 0)
+            sys.exit(1 if analysis.errors(diags + cap_diags) else 0)
         if analysis.errors(diags):
+            # capacity diagnostics deliberately stay out of this gate:
+            # CP/PL021 findings refuse a run only via --capacity
+            # enforce (capplan.preflight raised above) -- containment
             raise CliError(analysis.render_text(
                 analysis.errors(diags),
                 title="campaign matrix invalid:"))
+        if capacity == "warn" and (cap_diags or cap_plan is not None):
+            print(analysis.render_text(cap_diags,
+                                       title="capacity preflight:"))
+            if cap_plan is not None:
+                from .analysis import capplan
+                print(capplan.render_table(cap_plan))
+        elif cap_diags:
+            logger.warning("%s", analysis.render_text(
+                cap_diags, title="capacity preflight:"))
+        if slots == "auto":
+            from .analysis import capplan
+            resolved = capplan.auto_slots(cap_plan)
+            if resolved is None:
+                raise CliError(
+                    "--device-slots auto: the capacity plan has no "
+                    "computable slot count (pass --device-mem-budget "
+                    "and make sure the matrix has known-shape cells)")
+            logger.info("--device-slots auto -> %d", resolved)
+            options["device-slots"] = resolved
         if options.get("serve"):
             from . import web
             web.serve({"ip": options.get("serve-ip", "0.0.0.0"),
@@ -772,7 +886,8 @@ def campaign_cmd(opts):
                        "coalesce-window-ms":
                            options.get("coalesce-window-ms"),
                        "coalesce-max-segments":
-                           options.get("coalesce-max-segments")})
+                           options.get("coalesce-max-segments"),
+                       "capacity-plan": cap_plan})
         if workers is not None:
             from . import fleet
             try:
@@ -802,7 +917,10 @@ def campaign_cmd(opts):
                     coalesce_window_ms=options.get(
                         "coalesce-window-ms"),
                     coalesce_max_segments=options.get(
-                        "coalesce-max-segments"))
+                        "coalesce-max-segments"),
+                    capacity=capacity,
+                    device_mem_budget=budget,
+                    capacity_plan=cap_plan)
             except fleet.FleetError as e:
                 raise CliError(str(e)) from e
             print(campaign.report.render_text(report))
@@ -840,7 +958,8 @@ def campaign_cmd(opts):
                 resume=bool(options.get("resume")),
                 ledger=not options.get("no-ledger"),
                 backends=options.get("backends") or None,
-                fleetlint=options.get("fleetlint") != "off")
+                fleetlint=options.get("fleetlint") != "off",
+                capacity_plan=cap_plan)
         except campaign.CampaignError as e:
             raise CliError(str(e)) from e
         print(campaign.report.render_text(report))
@@ -885,6 +1004,16 @@ def serve_cmd():
                                  "past which the batch closes early "
                                  "(default 32; PL020 rejects "
                                  "non-positive values).")
+        parser.add_argument("--capacity-plan", default=None,
+                            metavar="FILE",
+                            help="A capacity_plan.json (from "
+                                 "`campaign --capacity plan` or "
+                                 "`tools/lint.py --matrix`) whose "
+                                 "predicted (model, bucket) shapes "
+                                 "pre-register on the coalescer, so "
+                                 "first-window strangers land in "
+                                 "planned compile shapes (PL021 "
+                                 "rejects unreadable files).")
 
     def run_serve(options):
         from . import web
@@ -897,12 +1026,15 @@ def serve_cmd():
             "coalesce-window-ms": options.get("coalesce-window-ms"),
             "coalesce-max-segments":
                 options.get("coalesce-max-segments")})
+        diags += planlint.lint_capacity({
+            "capacity-plan-file": options.get("capacity-plan")})
         if diags:
             print(render_text(diags, title="serve preflight:"))
         if errors(diags):
             raise CliError("refusing to serve: fix the preflight "
                            "errors above (bind 127.0.0.1 / pass "
-                           "--token / fix the coalesce knobs)")
+                           "--token / fix the coalesce or "
+                           "capacity-plan knobs)")
         web.serve({"ip": options.get("host", "0.0.0.0"),
                    "port": options.get("port", 8080),
                    "token": options.get("token"),
@@ -910,7 +1042,8 @@ def serve_cmd():
                    "coalesce-window-ms":
                        options.get("coalesce-window-ms"),
                    "coalesce-max-segments":
-                       options.get("coalesce-max-segments")})
+                       options.get("coalesce-max-segments"),
+                   "capacity-plan": options.get("capacity-plan")})
         print(f"Listening on http://{options.get('host')}:"
               f"{options.get('port')}/")
         try:

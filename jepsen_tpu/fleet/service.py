@@ -415,7 +415,8 @@ class Coalescer:
     """
 
     def __init__(self, window_s=DEFAULT_COALESCE_WINDOW_MS / 1000.0,
-                 max_segments=DEFAULT_COALESCE_MAX_SEGMENTS):
+                 max_segments=DEFAULT_COALESCE_MAX_SEGMENTS,
+                 planned=None):
         window_s = float(window_s)
         max_segments = int(max_segments)
         if window_s <= 0:
@@ -428,6 +429,7 @@ class Coalescer:
         self.max_segments = max_segments
         self._cond = threading.Condition()
         self._queues = {}       # (model, bucket) -> [_PendingSegment]
+        self._planned = {}      # model -> sorted [planned buckets]
         self._stopped = False
         self._thread = None     # started lazily on first submit
         self._batches = 0
@@ -435,15 +437,42 @@ class Coalescer:
         self._lanes = 0
         self._fallbacks = 0
         self._expired = 0
+        if planned:
+            self.preregister(planned)
 
     # -- the request side ----------------------------------------------
+
+    def preregister(self, keys):
+        """Seed the planned ``(model, bucket)`` shape set from a
+        capacity plan (analysis.capplan): a submission whose raw
+        pow-2 bucket falls BELOW a planned bucket for its model queues
+        on the smallest planned bucket >= it, so first-window
+        strangers land in planned (already-compiled, ledger-hitting)
+        shapes instead of discovering their own. Rounding only ever
+        goes UP -- padding rows are inert, so a coarser bucket is
+        always sound -- and models/buckets outside the plan keep the
+        raw rule."""
+        with self._cond:
+            for m, b in keys:
+                buckets = set(self._planned.get(str(m)) or ())
+                buckets.add(int(b))
+                self._planned[str(m)] = sorted(buckets)
+
+    def _bucket_key(self, spec, n_rows):
+        from ..campaign import compile_cache
+        raw = compile_cache.bucket_for(n_rows)
+        with self._cond:
+            planned = self._planned.get(spec.name) or ()
+        for b in planned:       # sorted ascending: smallest >= raw
+            if b >= raw:
+                return (spec.name, b)
+        return (spec.name, raw)
 
     def submit(self, spec, e, init_state, deadline, owner="local"):
         """Enqueue one encoded segment; returns the pending handle to
         `wait` on. Raises when the coalescer is stopped (the caller
         then checks solo)."""
-        from ..campaign import compile_cache
-        key = (spec.name, compile_cache.bucket_for(len(e)))
+        key = self._bucket_key(spec, len(e))
         item = _PendingSegment(spec, (e, init_state), deadline, owner)
         with self._cond:
             if self._stopped:
@@ -479,6 +508,8 @@ class Coalescer:
                     "expired": self._expired,
                     "queued": sum(len(q)
                                   for q in self._queues.values()),
+                    "planned": sum(len(v)
+                                   for v in self._planned.values()),
                     "occupancy": round(self._segments / self._lanes, 4)
                     if self._lanes else None}
 
@@ -539,7 +570,7 @@ class Coalescer:
                 else:
                     del self._queues[key]
             try:
-                self._dispatch(items)
+                self._dispatch(items, bucket=key[1])
             except Exception:  # noqa: BLE001 - thread must survive
                 logger.warning("coalesced batch dispatch crashed",
                                exc_info=True)
@@ -562,7 +593,7 @@ class Coalescer:
         except Exception:  # noqa: BLE001
             logger.warning("coalesce accounting failed", exc_info=True)
 
-    def _dispatch(self, items):
+    def _dispatch(self, items, bucket=None):
         spec = items[0].spec
         now = time.monotonic()
         live = []
@@ -587,9 +618,13 @@ class Coalescer:
                         max(it.deadline for it in live) - now)
         try:
             from ..parallel import keyshard
+            # pad the batch to its GROUP bucket, not a re-derived one:
+            # with capacity-plan pre-registration the group bucket may
+            # sit ABOVE every member's raw length, and the whole point
+            # is compiling at the planned (ledger-hitting) shape
             results = keyshard.check_batch_encoded(
                 spec, [it.pair for it in live], timeout_s=timeout_s,
-                owners=[it.owner for it in live])
+                owners=[it.owner for it in live], n_floor=bucket)
         except Exception:  # noqa: BLE001 - contained per batch
             logger.warning("coalesced batch failed; %d segment(s) "
                            "fall back to the solo path", len(live),
@@ -732,11 +767,14 @@ def admission():
         return _admission
 
 
-def configure_coalesce(enabled=True, window_ms=None, max_segments=None):
+def configure_coalesce(enabled=True, window_ms=None, max_segments=None,
+                       planned=None):
     """(Re)build the service-wide cross-tenant batcher. ``enabled``
     False tears it down (every check runs solo, the pre-coalescing
     behavior); ``window_ms``/``max_segments`` default to the module
-    constants. Returns the new `Coalescer` (or None when disabled).
+    constants; ``planned`` pre-registers a capacity plan's
+    ``(model, bucket)`` shapes (see `Coalescer.preregister`). Returns
+    the new `Coalescer` (or None when disabled).
     Replacing an existing coalescer stops it: its queued segments are
     delivered the solo-fallback sentinel, so in-flight requests
     complete correctly against the OLD configuration's containment
@@ -748,7 +786,8 @@ def configure_coalesce(enabled=True, window_ms=None, max_segments=None):
             else float(window_ms)
         m = DEFAULT_COALESCE_MAX_SEGMENTS if max_segments is None \
             else int(max_segments)
-        new = Coalescer(window_s=w / 1000.0, max_segments=m)
+        new = Coalescer(window_s=w / 1000.0, max_segments=m,
+                        planned=planned)
     with _lock:
         old = _coalescer
         _coalescer = new

@@ -110,7 +110,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
                         frontier_width=None, stack_size=None,
                         table_size=None, checkpoint=None,
                         checkpoint_every_s=60.0, rollout_seeds=None,
-                        owners=None):
+                        owners=None, n_floor=None):
     """Check many keys' histories at once.
 
     ``pairs`` is a list of (EncodedHistory, init_state). Returns a list of
@@ -127,6 +127,14 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     count of the searched keys lands in the padding-plan telemetry and
     every searched key's result carries it as ``batch_owners``, so a
     coalesced submission can see how many strangers shared its batch.
+
+    ``n_floor`` (optional) overrides the campaign-tunable op-count
+    bucket floor (``jax_wgl._n_floor``) for THIS batch: the service
+    coalescer passes its group's (possibly capacity-plan-raised)
+    bucket here so the batch compiles at the PLANNED shape rather
+    than re-deriving a smaller one from the members' raw lengths.
+    Only ever raises the pad (padding rows are inert), never lowers
+    it below the shared floor.
 
     ``checkpoint`` names a file the batch state is periodically
     snapshotted to (every ``checkpoint_every_s``, between chunks):
@@ -166,9 +174,10 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         return results
 
     # common bucket sizes across live keys (the op-count floor is the
-    # campaign-tunable shared bucket, jax_wgl._n_floor)
+    # campaign-tunable shared bucket, jax_wgl._n_floor; a caller may
+    # RAISE it per batch -- the coalescer's planned-bucket path)
     n_pad = _bucket(max(len(pairs[k][0]) for k in live),
-                    jax_wgl._n_floor())
+                    max(jax_wgl._n_floor(), int(n_floor or 1)))
     A = max(int(pairs[k][0].args.reshape(len(pairs[k][0]), -1).shape[1])
             for k in live)
     S_pad = max(len(pairs[k][1]) for k in live)

@@ -210,6 +210,60 @@ def _audit_header(cid):
     return fa if isinstance(fa, dict) else None
 
 
+def _capacity_table(data):
+    """The predicted-vs-actual compile-shape table for a
+    capacity-planned campaign (report.json["capacity"], written by
+    the capplan prediction oracle at finalize), or "" when the
+    campaign was never planned."""
+    cap = ((data or {}).get("report") or {}).get("capacity") or {}
+    oracle = cap.get("oracle")
+    if not oracle:
+        return ""
+    pred = {tuple(k) for k in oracle.get("predicted") or []}
+    act = {tuple(k) for k in oracle.get("actual") or []}
+    rows = []
+    for m, b in sorted(pred | act):
+        rows.append(
+            f"<tr><td>{html.escape(str(m))}</td><td>{b}</td>"
+            f"<td>{'yes' if (m, b) in pred else 'no'}</td>"
+            f"<td>{'yes' if (m, b) in act else 'no'}</td></tr>")
+    err = oracle.get("error_frac")
+    return (
+        "<h3>Capacity: predicted vs actual compile shapes</h3>"
+        f"<p>prediction error: {err if err is not None else '?'}"
+        + (f" &mdash; recommendation: set_n_floor("
+           f"{cap['recommendation']['set_n_floor']})"
+           if cap.get("recommendation") else "") + "</p>"
+        "<table><thead><tr><th>Model</th><th>Bucket</th>"
+        "<th>Predicted</th><th>Actual</th></tr></thead>"
+        f"<tbody>{''.join(rows)}</tbody></table>")
+
+
+def _waste_table(cid):
+    """The PR 13 padding-waste table (per n-bucket real vs padded
+    rows) from the campaign's metrics fold, rendered next to the
+    capacity table so predicted shapes and measured padding read
+    side by side; "" when the campaign has no fold."""
+    try:
+        with open(store.campaign_path(cid, "metrics_fold.json")) as f:
+            fold = json.load(f)
+        from .obs.merge import introspection_summary
+        padding = (introspection_summary(fold) or {}).get("padding")
+    except Exception:  # noqa: BLE001 - the page must render
+        return ""
+    if not padding:
+        return ""
+    rows = "".join(
+        f"<tr><td>{html.escape(str(b))}</td><td>{st['real']}</td>"
+        f"<td>{st['padded']}</td>"
+        f"<td>{st['waste_frac'] * 100:.1f}%</td></tr>"
+        for b, st in padding.items())
+    return ("<h3>Padding waste (per n-bucket)</h3>"
+            "<table><thead><tr><th>Bucket</th><th>Real</th>"
+            "<th>Padded</th><th>Waste</th></tr></thead>"
+            f"<tbody>{rows}</tbody></table>")
+
+
 def _campaigns_page():
     """Campaign index: one section per campaign, its runs grouped by
     cell (web's view of store/campaigns/<id>/). Fleet campaigns
@@ -264,6 +318,11 @@ def _campaigns_page():
                                               "campaign_trace.jsonl")):
             trace_link = (f' &mdash; <a href="{files}'
                           'campaign_trace.jsonl">merged trace</a>')
+        capacity_link = ""
+        if os.path.exists(store.campaign_path(cid,
+                                              "capacity_plan.json")):
+            capacity_link = (f' &mdash; <a href="{files}'
+                             'capacity_plan.json">capacity plan</a>')
         util = _utilization_rows(cid, records)
         util_table = ""
         if util:
@@ -283,7 +342,8 @@ def _campaigns_page():
             f'<h2><a href="{files}">{html.escape(cid)}</a></h2>'
             f"<p>status: {html.escape(str(meta.get('status')))} &mdash; "
             f"{len(records)}/{planned} cells ({html.escape(badge)})"
-            f"{audit_line}{trace_link}</p>{util_table}"
+            f"{audit_line}{trace_link}{capacity_link}</p>{util_table}"
+            f"{_capacity_table(data)}{_waste_table(cid)}"
             f"<table><thead><tr><th>Cell</th><th>Outcome</th>"
             f"<th>Valid?</th><th>Run</th><th>Wall (s)</th></tr></thead>"
             f"<tbody>{''.join(rows)}</tbody></table>")
@@ -570,7 +630,11 @@ def serve(opts=None):
     the service gate before the socket opens, and the cross-tenant
     coalescing knobs -- coalesce? (default True: queued ``jax-wgl``
     /api/check submissions merge into one padded device batch),
-    coalesce-window-ms, coalesce-max-segments."""
+    coalesce-window-ms, coalesce-max-segments, capacity-plan (a
+    capplan plan dict or a capacity_plan.json path whose predicted
+    (model, bucket) shapes pre-register on the coalescer, so
+    first-window strangers land in planned shapes instead of
+    discovering them)."""
     from .fleet import service
     opts = opts or {}
     qw = opts.get("queue-wait-s")
@@ -580,10 +644,22 @@ def serve(opts=None):
         service.configure(
             token=opts.get("token"), budgets=opts.get("budgets"),
             queue_wait_s=15.0 if qw is None else qw)
+    planned = None
+    cap = opts.get("capacity-plan")
+    if cap is not None:
+        try:
+            from .analysis import capplan
+            plan = capplan.load_plan(str(cap)) \
+                if not isinstance(cap, dict) else cap
+            planned = sorted(capplan.predicted_keys(plan))
+        except Exception:  # noqa: BLE001 - pre-registration is advisory
+            logger.warning("couldn't pre-register capacity-plan "
+                           "buckets (contained)", exc_info=True)
     service.configure_coalesce(
         enabled=opts.get("coalesce?", True),
         window_ms=opts.get("coalesce-window-ms"),
-        max_segments=opts.get("coalesce-max-segments"))
+        max_segments=opts.get("coalesce-max-segments"),
+        planned=planned)
     addr = (opts.get("ip", "0.0.0.0"), opts.get("port", 8080))
     server = ThreadingHTTPServer(addr, Handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True,

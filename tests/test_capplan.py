@@ -1,0 +1,640 @@
+"""capplan (the whole-campaign static capacity & shape planner) +
+sizemodel tests: size-model equivalence vs the live engine, every CP
+code from golden fixtures, the prediction oracle on a real CPU
+campaign, scheduler auto-slots, coalescer bucket pre-registration,
+enforce-mode refusal, PL021, and containment (a crashing planner
+never changes an outcome or exit)."""
+
+import json
+import random
+import threading
+
+import pytest
+
+from jepsen_tpu import client as jc
+from jepsen_tpu import store
+from jepsen_tpu.analysis import capplan, jaxlint, planlint, sizemodel
+from jepsen_tpu.campaign import compile_cache
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+
+
+REGISTER_MATRIX = {"base": {"workload": "register", "concurrency": 10},
+                   "axes": {"seed": [0, 1], "per-key-limit": [20, 40]}}
+
+FRAGMENTED_MATRIX = {
+    "base": {"workload": "register"},
+    "axes": {"per-key-limit": [20, 120, 260, 600, 1200], "seed": [0]}}
+
+
+def codes(diags):
+    return [d.code for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# sizemodel: equivalence with the live engine (the no-drift contract)
+
+
+def test_plan_sizes_delegates_to_live_engine():
+    from jepsen_tpu.checker import jax_wgl
+    for args in ((64, 1, 4), (1024, 8, 16), (16384, 8192, 512),
+                 (2048, 2, 64)):
+        assert sizemodel.plan_sizes(*args) == jax_wgl._plan_sizes(*args)
+
+
+def test_bucket_for_delegates_to_compile_cache():
+    assert sizemodel.bucket_for(22) == compile_cache.bucket_for(22)
+    with compile_cache.bucket_floor(256):
+        assert sizemodel.bucket_for(22) == 256
+        assert sizemodel.n_floor() == 256
+
+
+def test_history_cell_math_matches_jaxlint_formula():
+    # the formula jaxlint.lint_history_size documented: keys*n*(2A+4)
+    assert sizemodel.history_cells(10, arg_width=1, keys=2) \
+        == 2 * 10 * 6
+    assert sizemodel.history_ranks(10) == 20
+
+
+def test_jaxlint_delegates_to_sizemodel(monkeypatch):
+    # jaxlint must consume sizemodel's math, not a private copy: an
+    # inflated sizemodel answer must flip JX004 on a tiny history
+    assert jaxlint.lint_history_size(10) == []
+    monkeypatch.setattr(sizemodel, "history_cells",
+                        lambda n, a=1, k=1: sizemodel.INT32_CELL_LIMIT)
+    diags = jaxlint.lint_history_size(10)
+    assert [d.code for d in diags] == ["JX004"]
+
+
+def test_search_shape_register():
+    sh = sizemodel.search_shape("cas-register", 22, concurrency=10)
+    assert sh["model"] == "cas-register"
+    assert sh["bucket"] == 64          # default floor
+    assert sh["A"] == 2 and sh["S"] == 1
+    assert sh["hbm"]["total"] > 0
+    assert 0 < sh["int32"]["frac"] < 0.5
+
+
+def test_ledger_key_shape_projections():
+    # mirrors the _note_compile key layouts (pinned live by the
+    # oracle test below)
+    assert sizemodel.ledger_key_shape(
+        "jax-wgl", ("cas-register", 64, 2, 1, 4, 2, 64, 4096, 1024,
+                    "auto", None, None)) == ("cas-register", 64)
+    assert sizemodel.ledger_key_shape(
+        "jax-wgl-batch", ["cas-register", 8, 64, 64, 2, 1, 4, 2,
+                          4096, 1024, 1, 0, None, False]) \
+        == ("cas-register", 64)
+    assert sizemodel.ledger_key_shape("linear", ("m", 64)) is None
+    assert sizemodel.ledger_key_shape("jax-wgl", ()) is None
+
+
+# ---------------------------------------------------------------------------
+# build_plan: the CP codes, each from a golden fixture
+
+
+def test_cp002_census_and_single_bucket():
+    plan, diags = capplan.build_plan(REGISTER_MATRIX)
+    assert plan["compiles"]["keys"] == [["cas-register", 64]]
+    assert plan["unknown_cells"] == 0
+    assert "CP002" in codes(diags)
+    assert not [d for d in diags if d.severity == "error"]
+
+
+def test_cp001_unknown_workload_and_runtime_bound():
+    plan, diags = capplan.build_plan(
+        {"axes": {"workload": ["mystery"]}})
+    assert "CP001" in codes(diags)
+    assert plan["unknown_cells"] == 1
+    assert plan["cells"][0]["unknown"] is True
+    # a register cell with no per-key bound is runtime-bound: unknown
+    plan, diags = capplan.build_plan(
+        {"base": {"workload": "register", "per-key-limit": 0},
+         "axes": {"seed": [0]}})
+    assert "CP001" in codes(diags)
+
+
+def test_known_empty_workloads_are_not_unknown():
+    plan, diags = capplan.build_plan(
+        {"axes": {"workload": ["noop", "bank", "set", "append"]}})
+    assert plan["unknown_cells"] == 0
+    assert plan["compiles"]["distinct"] == 0
+    assert "CP001" not in codes(diags)
+
+
+def test_cp003_fragmented_buckets_with_computed_floor():
+    plan, diags = capplan.build_plan(FRAGMENTED_MATRIX)
+    assert plan["compiles"]["distinct"] > jaxlint.MAX_PLAN_SHAPES
+    cp3 = [d for d in diags if d.code == "CP003"]
+    assert cp3 and "set_n_floor" in cp3[0].fix_hint
+    rec = plan["recommendation"]
+    assert rec["distinct_after"] < rec["distinct_before"]
+    assert rec["distinct_after"] <= jaxlint.MAX_PLAN_SHAPES
+    # the recommendation provably reduces distinct shapes: re-plan
+    # under the recommended floor and the census must shrink to it
+    with compile_cache.bucket_floor(rec["set_n_floor"]):
+        plan2, _ = capplan.build_plan(FRAGMENTED_MATRIX)
+    assert plan2["compiles"]["distinct"] == rec["distinct_after"]
+
+
+def test_recommend_floor_pow2_and_noop_when_fits():
+    assert capplan.recommend_floor({("m", 64), ("m", 128)}) is None
+    rec = capplan.recommend_floor(
+        {("m", b) for b in (64, 128, 256, 512, 1024)})
+    f = rec["set_n_floor"]
+    assert f & (f - 1) == 0          # power of two
+    assert rec["distinct_after"] <= jaxlint.MAX_PLAN_SHAPES
+
+
+def test_cp004_cell_exceeds_budget():
+    plan, diags = capplan.build_plan(REGISTER_MATRIX,
+                                     device_mem_budget=1024)
+    cp4 = [d for d in diags if d.code == "CP004"]
+    assert cp4 and cp4[0].severity == "error"
+    assert plan["hbm"]["auto_slots"] is None
+
+
+def test_cp005_cp006_slots_vs_budget():
+    plan, diags = capplan.build_plan(REGISTER_MATRIX,
+                                     device_mem_budget=1 << 30,
+                                     device_slots=500)
+    assert "CP006" in codes(diags)
+    cp5 = [d for d in diags if d.code == "CP005"]
+    assert cp5 and "auto" in cp5[0].fix_hint
+    auto = plan["hbm"]["auto_slots"]
+    assert auto >= 1
+    assert auto * plan["hbm"]["per_cell_peak_bytes"] <= (1 << 30)
+    assert capplan.auto_slots(plan) == auto
+    # a request within the budget draws no CP005
+    _, diags2 = capplan.build_plan(REGISTER_MATRIX,
+                                   device_mem_budget=1 << 30,
+                                   device_slots=1)
+    assert "CP005" not in codes(diags2)
+
+
+def test_cp007_int32_proximity():
+    plan, diags = capplan.build_plan(
+        {"base": {"workload": "register",
+                  "per-key-limit": 7_000_000},
+         "axes": {"seed": [0]}})
+    assert "CP007" in codes(diags)
+    assert "CP008" not in codes(diags)
+    assert 0.5 <= plan["int32"]["max_frac"] < 1.0
+
+
+def test_cp008_int32_wall_crossed():
+    plan, diags = capplan.build_plan(
+        {"base": {"workload": "register", "per-key-limit": 2 ** 25},
+         "axes": {"seed": [0]}})
+    cp8 = [d for d in diags if d.code == "CP008"]
+    assert cp8 and cp8[0].severity == "error"
+    assert plan["int32"]["max_frac"] >= 1.0
+
+
+def test_plan_is_byte_deterministic(tmp_path):
+    p1, _ = capplan.build_plan(FRAGMENTED_MATRIX,
+                               device_mem_budget=1 << 30)
+    p2, _ = capplan.build_plan(FRAGMENTED_MATRIX,
+                               device_mem_budget=1 << 30)
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    capplan.dump_plan(p1, str(a))
+    capplan.dump_plan(p2, str(b))
+    assert a.read_bytes() == b.read_bytes()
+    assert capplan.load_plan(str(a)) == p1
+
+
+def test_render_table_mentions_every_cell():
+    plan, _ = capplan.build_plan(REGISTER_MATRIX)
+    text = capplan.render_table(plan)
+    for cell in plan["cells"]:
+        assert cell["cell"] in text
+    assert "distinct compile shapes" in text
+
+
+# ---------------------------------------------------------------------------
+# PL021
+
+
+def test_pl021_matrix():
+    err = [d for d in planlint.lint_capacity({"capacity": "bogus"})]
+    assert codes(err) == ["PL021"] and err[0].severity == "error"
+    assert [d.severity for d in planlint.lint_capacity(
+        {"capacity": "enforce"})] == ["error"]
+    assert [d.severity for d in planlint.lint_capacity(
+        {"device-slots": "auto"})] == ["error"]
+    assert [d.severity for d in planlint.lint_capacity(
+        {"capacity": "warn", "device-mem-budget": -5})] == ["error"]
+    # budget with nothing consuming it: warning, not error
+    assert [d.severity for d in planlint.lint_capacity(
+        {"device-mem-budget": 1 << 30})] == ["warning"]
+    # enforce over unknown-shape cells: warning
+    ds = planlint.lint_capacity({"capacity": "enforce",
+                                 "device-mem-budget": 1 << 30,
+                                 "unknown-cells": 2})
+    assert [d.severity for d in ds] == ["warning"]
+    # clean configs draw nothing
+    assert planlint.lint_capacity({"capacity": "warn"}) == []
+    assert planlint.lint_capacity({}) == []
+
+
+def test_pl021_capacity_plan_file(tmp_path):
+    missing = tmp_path / "nope.json"
+    ds = planlint.lint_capacity({"capacity-plan-file": str(missing)})
+    assert codes(ds) == ["PL021"] and ds[0].severity == "error"
+    plan, _ = capplan.build_plan(REGISTER_MATRIX)
+    p = tmp_path / "plan.json"
+    capplan.dump_plan(plan, str(p))
+    assert planlint.lint_capacity({"capacity-plan-file": str(p)}) == []
+
+
+# ---------------------------------------------------------------------------
+# preflight: enforce refusal + containment
+
+
+def test_enforce_refuses_on_pl021_and_cp_errors():
+    with pytest.raises(capplan.CapacityError):
+        capplan.preflight(REGISTER_MATRIX, mode="enforce")  # no budget
+    with pytest.raises(capplan.CapacityError):
+        capplan.preflight(
+            {"base": {"workload": "register",
+                      "per-key-limit": 2 ** 25},
+             "axes": {"seed": [0]}},
+            mode="enforce", device_mem_budget=1 << 40)     # CP008
+    # a clean matrix passes enforce
+    plan, diags = capplan.preflight(REGISTER_MATRIX, mode="enforce",
+                                    device_mem_budget=1 << 30)
+    assert plan is not None
+    assert not [d for d in diags if d.severity == "error"]
+
+
+def test_preflight_contained_on_planner_crash(monkeypatch):
+    def boom(*a, **k):
+        raise RuntimeError("planner bug")
+    monkeypatch.setattr(capplan, "build_plan", boom)
+    # warn mode: crash is swallowed, plan None, no raise
+    plan, diags = capplan.preflight(REGISTER_MATRIX, mode="warn")
+    assert plan is None
+    # enforce: a CRASH (vs an error finding) must also never refuse
+    plan, diags = capplan.preflight(REGISTER_MATRIX, mode="enforce",
+                                    device_mem_budget=1 << 30)
+    assert plan is None
+
+
+def test_run_fleet_enforce_refusal_is_preflight():
+    from jepsen_tpu import fleet
+    cells = [{"id": "seed=0", "group": "g", "params": {"seed": 0}}]
+    with pytest.raises(fleet.FleetError):
+        fleet.run_fleet(cells, ["local"], capacity="enforce",
+                        base_options={"workload": "register"})
+    # refused at preflight: no journal was ever created
+    assert store.latest_campaign() is None
+
+
+# ---------------------------------------------------------------------------
+# the scheduler wiring: persisted plan, oracle, containment
+
+
+class OkClient(jc.Client):
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        out = dict(op)
+        out["type"] = "ok"
+        return out
+
+
+def quick_cells(n=2):
+    from jepsen_tpu import checker as cc
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import tests as tst
+
+    def cell(i):
+        t = tst.noop_test()
+        t.update({"name": f"cap-{i}", "ssh": {"dummy?": True},
+                  "obs?": False, "nodes": ["n1"], "concurrency": 1,
+                  "client": OkClient(), "checker": cc.noop(),
+                  "generator": gen.clients(gen.limit(
+                      3, gen.repeat({"f": "read"})))})
+        return {"id": f"cap-{i}", "test": t}
+    return [cell(i) for i in range(n)]
+
+
+def test_containment_crashing_oracle_never_changes_outcome(
+        monkeypatch):
+    from jepsen_tpu import campaign
+    plan, _ = capplan.build_plan(REGISTER_MATRIX)
+
+    def boom(*a, **k):
+        raise RuntimeError("oracle bug")
+    monkeypatch.setattr(capplan, "report_section", boom)
+    report = campaign.run_cells(quick_cells(), campaign_id="contain",
+                                capacity_plan=plan)
+    # the campaign is untouched: every cell terminal, outcomes clean,
+    # only the capacity block is missing
+    assert report["summary"]["outcomes"] == {"True": 2}
+    assert "capacity" not in report
+    from jepsen_tpu.cli import campaign_exit_code
+    assert campaign_exit_code(report) == 0
+
+
+def test_containment_unpersistable_plan(monkeypatch):
+    from jepsen_tpu import campaign
+    monkeypatch.setattr(capplan, "dump_plan",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            OSError("disk full")))
+    report = campaign.run_cells(quick_cells(), campaign_id="contain2",
+                                capacity_plan={"whatever": 1})
+    assert report["summary"]["outcomes"] == {"True": 2}
+    assert "capacity" not in report
+
+
+def test_scheduler_persists_plan_and_runs_oracle():
+    from jepsen_tpu import campaign
+    plan, _ = capplan.build_plan(
+        {"axes": {"workload": ["noop"], "seed": [0, 1]}})
+    report = campaign.run_cells(quick_cells(), campaign_id="persist",
+                                capacity_plan=plan)
+    p = store.campaign_path("persist", capplan.PLAN_FILE)
+    assert capplan.load_plan(p) == plan
+    cap = report["capacity"]
+    # noop cells compile nothing and the plan predicts nothing
+    assert cap["oracle"]["predicted"] == []
+    assert cap["oracle"]["error_frac"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# THE prediction oracle: a real CPU register campaign
+
+
+def test_prediction_oracle_on_real_campaign():
+    from jepsen_tpu import campaign
+    from jepsen_tpu.cli import test_opt_fn
+    from jepsen_tpu.demo import demo_test
+
+    options = test_opt_fn({"no-ssh": True, "workload": "register",
+                           "time-limit": 1, "concurrency": "1n",
+                           "nodes": "n1,n2"})
+    matrix = {"axes": {"seed": [0]}}
+    cells_plan = campaign.plan.expand(matrix)
+    plan, _diags = capplan.preflight(cells_plan, base=options,
+                                     mode="plan")
+    assert plan["compiles"]["keys"] == [["cas-register", 64]]
+
+    lock = threading.Lock()
+
+    def build(params):
+        o = dict(options)
+        o.update(params)
+        with lock:
+            if "seed" in params:
+                random.seed(params["seed"])
+            return demo_test(o)
+
+    cells = [{"id": c["id"], "group": c["group"],
+              "params": c["params"], "build": build}
+             for c in cells_plan]
+    report = campaign.run_cells(cells, campaign_id="oracle",
+                                capacity_plan=plan)
+    assert report["summary"]["outcomes"] == {"True": 1}
+    oracle = report["capacity"]["oracle"]
+    # the acceptance criterion: predicted (model, bucket) set equals
+    # the compile ledger's actual keys -- zero prediction error
+    assert oracle["missed"] == [], oracle
+    assert oracle["unplanned"] == [], oracle
+    assert oracle["error_frac"] == 0.0
+    assert oracle["actual"] == [["cas-register", 64]]
+
+    # trace_summary --campaign prints the predicted-vs-actual table
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary_capplan",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "trace_summary.py"))
+    ts = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ts)
+    text = ts.summarize_campaign(store.campaign_path("oracle"))
+    assert "capacity plan (predicted vs actual)" in text
+    assert "prediction error: 0.0" in text
+
+    # the web campaign table renders from the same report block
+    from jepsen_tpu import web
+    html_table = web._capacity_table({"report": report})
+    assert "cas-register" in html_table and "Predicted" in html_table
+
+
+def test_cli_device_slots_auto_resolves_from_plan(monkeypatch,
+                                                  tmp_path):
+    # the campaign subcommand must hand run_cells the RESOLVED slot
+    # count (budget // peak footprint), not the "auto" placeholder
+    from jepsen_tpu import campaign as campaign_mod
+    from jepsen_tpu import cli
+    from jepsen_tpu.cli import test_opt_fn
+    seen = {}
+
+    def fake_run_cells(cells, **kw):
+        seen.update(kw, cells=len(cells))
+        return {"status": "complete",
+                "summary": {"outcomes": {"True": len(cells)}},
+                "results": {"True": ["x"] * len(cells)}}
+
+    monkeypatch.setattr(campaign_mod, "run_cells", fake_run_cells)
+    cmd = cli.campaign_cmd({"test-fn": lambda o: {}})
+    options = test_opt_fn({"no-ssh": True, "workload": "register",
+                           "time-limit": 1, "concurrency": "1n"})
+    options.update({"axis": ["seed=0,1"], "seeds": None,
+                    "capacity": "plan",
+                    "device-mem-budget": 1 << 30,
+                    "device-slots": "auto", "parallel": 1})
+    with pytest.raises(SystemExit) as e:
+        cmd["campaign"]["run"](options)
+    assert e.value.code == 0
+    assert isinstance(seen["device_slots"], int)
+    assert seen["device_slots"] >= 1
+    assert seen["capacity_plan"]["compiles"]["keys"] \
+        == [["cas-register", 64]]
+
+
+def test_cli_device_slots_auto_rejected_without_plan():
+    from jepsen_tpu import cli
+    with pytest.raises(cli.CliError):
+        cli.test_all_cmd({"tests-fn": lambda o: []})["test-all"][
+            "run"]({"device-slots": "auto"})
+
+
+# ---------------------------------------------------------------------------
+# coalescer bucket pre-registration
+
+
+def test_coalescer_preregistration_rounds_up_to_planned():
+    from jepsen_tpu.fleet.service import Coalescer
+    from jepsen_tpu.models import model_spec
+    spec = model_spec("cas-register")
+    c = Coalescer(window_s=60.0,
+                  planned=[("cas-register", 256),
+                           ("cas-register", 1024)])
+    try:
+        assert c._bucket_key(spec, 100) == ("cas-register", 256)
+        assert c._bucket_key(spec, 300) == ("cas-register", 1024)
+        # above every planned bucket: the raw rule (rounding only
+        # ever goes UP)
+        assert c._bucket_key(spec, 2000) == ("cas-register", 2048)
+        # an unplanned model keeps the raw rule
+        reg = model_spec("register")
+        assert c._bucket_key(reg, 100) == ("register", 128)
+        assert c.stats()["planned"] == 2
+    finally:
+        c.stop()
+
+
+def test_coalescer_submit_queues_on_planned_bucket():
+    from jepsen_tpu.fleet.service import Coalescer
+    from jepsen_tpu.models import model_spec
+    spec = model_spec("cas-register")
+    c = Coalescer(window_s=60.0, planned=[("cas-register", 512)])
+    try:
+        item = c.submit(spec, list(range(100)), None,
+                        deadline=1e18, owner="t1")
+        with c._cond:
+            assert list(c._queues) == [("cas-register", 512)]
+            assert c._queues[("cas-register", 512)] == [item]
+    finally:
+        c.stop()
+
+
+def test_coalescer_without_plan_keeps_raw_rule():
+    from jepsen_tpu.fleet.service import Coalescer
+    from jepsen_tpu.models import model_spec
+    c = Coalescer(window_s=60.0)
+    try:
+        assert c._bucket_key(model_spec("cas-register"), 100) \
+            == ("cas-register", 128)
+        assert c.stats()["planned"] == 0
+    finally:
+        c.stop()
+
+
+def test_coalescer_dispatch_compiles_at_planned_bucket(monkeypatch):
+    # pre-registration must reach the COMPILED shape, not just the
+    # queue key: the dispatch hands the group bucket to keyshard as
+    # the batch's op-count floor
+    from jepsen_tpu.fleet import service
+    from jepsen_tpu.models import model_spec
+    from jepsen_tpu.parallel import keyshard
+    spec = model_spec("cas-register")
+    seen = {}
+
+    def fake_batch(spec_, pairs, **kw):
+        seen.update(kw, pairs=len(pairs))
+        return [{"valid": True, "configs_explored": 0}] * len(pairs)
+
+    monkeypatch.setattr(keyshard, "check_batch_encoded", fake_batch)
+    c = service.Coalescer(window_s=0.01,
+                          planned=[("cas-register", 256)])
+    try:
+        hist = [{"index": 0, "type": "invoke", "f": "write",
+                 "value": 1, "process": 0},
+                {"index": 1, "type": "ok", "f": "write", "value": 1,
+                 "process": 0}]
+        e, init = spec.encode(hist)
+        item = c.submit(spec, e, init, deadline=__import__(
+            "time").monotonic() + 30)
+        r = c.wait(item)
+        assert r == {"valid": True, "configs_explored": 0}
+        assert seen["n_floor"] == 256, seen
+    finally:
+        c.stop()
+
+
+def test_keyshard_n_floor_override_raises_pad():
+    # the override only ever RAISES the pad (bucket(max_len, floor))
+    from jepsen_tpu.models import model_spec
+    from jepsen_tpu.parallel import keyshard
+    spec = model_spec("cas-register")
+    hist = [{"index": 0, "type": "invoke", "f": "write", "value": 1,
+             "process": 0},
+            {"index": 1, "type": "ok", "f": "write", "value": 1,
+             "process": 0},
+            {"index": 2, "type": "invoke", "f": "read", "value": None,
+             "process": 0},
+            {"index": 3, "type": "ok", "f": "read", "value": 1,
+             "process": 0}]
+    pair = spec.encode(hist)
+    before = compile_cache.noted_keys()
+    out = keyshard.check_batch_encoded(spec, [pair], n_floor=128)
+    assert out[0]["valid"] is True
+    new = compile_cache.noted_keys() - before
+    buckets = {sizemodel.ledger_key_shape(e, k) for e, k in new}
+    assert ("cas-register", 128) in buckets, buckets
+
+
+def test_oracle_warm_ledger_keys_are_not_missed():
+    plan, _ = capplan.build_plan(REGISTER_MATRIX)
+    warm = [("jax-wgl-batch",
+             ("cas-register", 8, 64, 64, 2, 1, 4, 2, 4096, 1024, 1,
+              0, None, False))]
+    # nothing compiled fresh, but the predicted shape was already on
+    # disk: "warm" (unverifiable), never "missed", error 0.0
+    o = capplan.oracle(plan, [], warm_keys=warm)
+    assert o["missed"] == [] and o["unplanned"] == []
+    assert o["warm"] == [["cas-register", 64]]
+    assert o["error_frac"] == 0.0
+    # a genuinely unpredicted fresh compile still counts against it
+    o2 = capplan.oracle(plan, warm, warm_keys=warm)
+    assert o2["warm"] == [] and o2["missed"] == []
+    assert o2["error_frac"] == 0.0
+
+
+def test_preflight_budget_alone_builds_no_plan():
+    plan, diags = capplan.preflight(REGISTER_MATRIX,
+                                    device_mem_budget=1 << 30)
+    assert plan is None
+    assert [d.code for d in diags] == ["PL021"]
+    assert diags[0].severity == "warning"     # "the knob is ignored"
+
+
+def test_configure_coalesce_planned_passthrough():
+    from jepsen_tpu.fleet import service
+    try:
+        coal = service.configure_coalesce(
+            planned=[("cas-register", 256)])
+        assert coal.stats()["planned"] == 1
+    finally:
+        service.reset()
+
+
+# ---------------------------------------------------------------------------
+# tools/lint.py --matrix
+
+
+def _lint_main(argv):
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "lint_capplan",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(argv)
+
+
+def test_lint_matrix_clean_and_cp_error(tmp_path, capsys):
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps(REGISTER_MATRIX))
+    assert _lint_main(["--matrix", str(clean)]) == 0
+    out = capsys.readouterr().out
+    assert "capacity plan" in out and "cas-register" in out
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(
+        {"base": {"workload": "register", "per-key-limit": 2 ** 25},
+         "axes": {"seed": [0]}}))
+    assert _lint_main(["--matrix", str(bad)]) == 1
+    assert "CP008" in capsys.readouterr().out
+    assert _lint_main(["--matrix", str(tmp_path / "missing.json")]) \
+        == 2

@@ -9,6 +9,7 @@ Usage::
     python tools/lint.py --json          # machine-readable diagnostics
     python tools/lint.py --no-ruff       # codelint only
     python tools/lint.py --campaign [ID] # fleetlint a stored campaign
+    python tools/lint.py --matrix FILE  # capplan a campaign matrix
 
 Exit codes: 0 clean (warnings allowed), 1 error-severity codelint
 diagnostics or ruff violations, 2 internal error. ruff is optional at
@@ -20,6 +21,13 @@ instead of linting source, it replays a stored campaign's artifacts
 (``store/campaigns/<ID>/``; default: the most recent campaign)
 through ``analysis.fleetlint``, persists ``fleet_analysis.json``, and
 exits 1 on FL error diagnostics -- the CI chaos-soak oracle.
+
+``--matrix FILE`` dry-runs the capacity planner (analysis.capplan)
+over a campaign matrix JSON (``{"base": {...}, "axes": {...}}``):
+prints the capacity table -- per-cell compile shapes, HBM footprints,
+int32-wall proximity -- plus the CP001-CP008 diagnostics, and exits 1
+on CP errors. ``--device-mem-budget BYTES`` enables the HBM half.
+Nothing runs, nothing is written.
 """
 
 from __future__ import annotations
@@ -93,6 +101,30 @@ def run_campaign_audit(campaign_id, as_json=False):
     return 1 if analysis.errors(diags) else 0
 
 
+def run_matrix_plan(path, device_mem_budget=None, as_json=False):
+    """capplan a campaign matrix file; returns the exit code (0 clean
+    / warnings, 1 CP errors, 2 unreadable matrix)."""
+    from jepsen_tpu.analysis import capplan
+    try:
+        with open(path) as f:
+            matrix = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"couldn't read matrix {path!r}: {e}", file=sys.stderr)
+        return 2
+    if not isinstance(matrix, dict):
+        print(f"matrix {path!r} is not a JSON object", file=sys.stderr)
+        return 2
+    plan, diags = capplan.build_plan(
+        matrix, device_mem_budget=device_mem_budget)
+    if as_json:
+        print(json.dumps(plan, indent=1, sort_keys=True))
+    else:
+        print(capplan.render_table(plan))
+        print(analysis.render_text(diags,
+                                   title=f"capacity plan: {path}"))
+    return 1 if analysis.errors(diags) else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=None,
@@ -111,10 +143,25 @@ def main(argv=None):
                          "artifacts with fleetlint instead of linting "
                          "source (default ID: the latest campaign); "
                          "exit 1 on FL errors")
+    ap.add_argument("--matrix", default=None, metavar="FILE",
+                    help="dry-run the capacity planner (capplan) over "
+                         "a campaign matrix JSON: print the capacity "
+                         "table + CP diagnostics; exit 1 on CP errors")
+    ap.add_argument("--device-mem-budget", default=None,
+                    metavar="BYTES",
+                    help="usable device HBM in bytes for --matrix "
+                         "(K/M/G/T suffixes accepted)")
     opts = ap.parse_args(argv)
 
     if opts.campaign is not None:
         return run_campaign_audit(opts.campaign, as_json=opts.json)
+    if opts.matrix is not None:
+        budget = None
+        if opts.device_mem_budget is not None:
+            from jepsen_tpu.cli import parse_bytes
+            budget = parse_bytes(opts.device_mem_budget)
+        return run_matrix_plan(opts.matrix, device_mem_budget=budget,
+                               as_json=opts.json)
 
     paths = list(opts.paths) or [os.path.join(REPO, p)
                                  for p in DEFAULT_PATHS

@@ -249,12 +249,15 @@ def summarize_campaign(campaign_dir):
     """Render the campaign view of a merged trace; returns the text."""
     lines = [f"== campaign {campaign_dir} =="]
     trace_path = os.path.join(campaign_dir, "campaign_trace.jsonl")
+    events = []
     if not os.path.exists(trace_path):
+        # keep going: the report-based sections (capacity oracle,
+        # metrics fold, fleetlint audit) don't need the merged trace
         lines.append("(no campaign_trace.jsonl — run the fleet with "
                      "trace merge enabled, or merge with "
                      "jepsen_tpu.obs.merge.merge_campaign)")
-        return "\n".join(lines)
-    events = _load_trace(trace_path)
+    else:
+        events = _load_trace(trace_path)
 
     report = {}
     try:
@@ -275,7 +278,8 @@ def summarize_campaign(campaign_dir):
              for e in events
              if e.get("ph") == "M" and e.get("name") == "process_name"}
     winfo = (report.get("trace") or {}).get("workers") or {}
-    lines.append(f"\n-- lanes ({len(events)} events) --")
+    if events:
+        lines.append(f"\n-- lanes ({len(events)} events) --")
     for pid in sorted(lanes):
         name = lanes[pid]
         extra = ""
@@ -389,6 +393,9 @@ def summarize_campaign(campaign_dir):
     if fold is not None:
         lines += _introspection_lines(fold, makespan_s)
 
+    # -- capacity plan: predicted vs actual compile shapes --------------
+    lines += _capacity_lines(campaign_dir, report)
+
     # -- control-plane audit (analysis.fleetlint) -----------------------
     fa = _fleet_audit(campaign_dir)
     if fa is None:
@@ -411,6 +418,41 @@ def summarize_campaign(campaign_dir):
                          f"{d.get('code')}{loc}: {d.get('message')}")
 
     return "\n".join(lines)
+
+
+def _capacity_lines(campaign_dir, report):
+    """The capacity planner's predicted-vs-actual bucket error for a
+    planned campaign (report.json["capacity"], the capplan prediction
+    oracle); [] when the campaign was never planned."""
+    cap = (report or {}).get("capacity")
+    if not cap and os.path.exists(os.path.join(campaign_dir,
+                                               "capacity_plan.json")):
+        cap = {"oracle": None}
+    if not cap:
+        return []
+    lines = ["\n-- capacity plan (predicted vs actual) --"]
+    oracle = cap.get("oracle")
+    if not oracle:
+        lines.append("(capacity_plan.json present but no oracle in "
+                     "report.json -- campaign not finalized?)")
+        return lines
+    pred = {tuple(k) for k in oracle.get("predicted") or []}
+    act = {tuple(k) for k in oracle.get("actual") or []}
+    lines.append(f"{'model':<20} {'bucket':>7}  predicted  actual")
+    for m, b in sorted(pred | act):
+        lines.append(f"{m:<20} {b:>7}  "
+                     f"{'yes' if (m, b) in pred else 'no':>9}  "
+                     f"{'yes' if (m, b) in act else 'no'}")
+    lines.append(f"prediction error: {oracle.get('error_frac')} "
+                 f"({len(oracle.get('missed') or [])} missed, "
+                 f"{len(oracle.get('unplanned') or [])} unplanned)")
+    rec = cap.get("recommendation")
+    if rec:
+        lines.append(f"recommendation: set_n_floor("
+                     f"{rec['set_n_floor']}) -> "
+                     f"{rec['distinct_after']} shape(s) "
+                     f"(from {rec['distinct_before']})")
+    return lines
 
 
 def _fleet_audit(campaign_dir):

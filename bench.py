@@ -657,6 +657,17 @@ def _introspection_overhead_rung(pairs=5, n_ops=2000):
         busy = float(best_reg.counter_value(
             "wgl.device_busy_s", engine="jax-wgl")) \
             if best_reg is not None else None
+        # chunk wall + per-phase breakdown from the SAME best run's
+        # registry: busy is now the device-compute bracket, so the
+        # chunk_s sum supplies the old full-dispatch-wall context and
+        # phase_s says where the difference went
+        intro = {}
+        if best_reg is not None:
+            try:
+                from jepsen_tpu.obs.merge import introspection_summary
+                intro = introspection_summary(best_reg.snapshot())
+            except Exception:  # noqa: BLE001
+                intro = {}
         return {
             "n_ops": n_ops, "ops": len(e), "pairs": pairs,
             "valid": best_on.get("valid") if best_on else None,
@@ -669,6 +680,8 @@ def _introspection_overhead_rung(pairs=5, n_ops=2000):
             if busy is not None else None,
             "duty_cycle": round(busy / on_s, 4)
             if busy is not None and on_s > 0 else None,
+            "chunk_s": intro.get("chunk_s"),
+            "phase_s": intro.get("phase_s"),
             "off_s": round(off_s, 4),
             "off_runs": [round(x, 3) for x in off_runs],
             "on_s": round(on_s, 4),
@@ -762,6 +775,18 @@ def _service_throughput_rung(clients=8, per_client=3, bursts=10):
                        reg.snapshot()["counters"].items()
                        if k.startswith("wgl.device_busy_s"))
 
+        def reg_chunk():
+            # full dispatch-chunk wall (the wgl.chunk_s histogram):
+            # busy above is the device-compute bracket, chunk is the
+            # old whole-chunk meaning, busy <= chunk always
+            reg = obs.registry()
+            if reg is None:
+                return 0.0
+            return sum(float((h or {}).get("sum") or 0.0)
+                       for k, h in
+                       reg.snapshot()["histograms"].items()
+                       if k.startswith("wgl.chunk_s"))
+
         def fan_out(flag):
             lat = [[None] * per_client for _ in range(clients)]
             vrd = [[None] * per_client for _ in range(clients)]
@@ -790,9 +815,11 @@ def _service_throughput_rung(clients=8, per_client=3, bursts=10):
             fan_out(flag)                     # warm pass: compiles
             st0 = service.coalescer().stats()
             busy0 = reg_busy()
+            chunk0 = reg_chunk()
             wall, lat, vrd, errors = fan_out(flag)
             st1 = service.coalescer().stats()
             busy = reg_busy() - busy0
+            chunk = reg_chunk() - chunk0
             flat = sorted(x for row in lat for x in row
                           if x is not None)
             n = len(flat)
@@ -807,6 +834,7 @@ def _service_throughput_rung(clients=8, per_client=3, bursts=10):
                 "batches": st1["batches"] - st0["batches"],
                 "segments": st1["segments"] - st0["segments"],
                 "device_busy_s": round(busy, 3),
+                "chunk_s": round(chunk, 3),
                 "duty_cycle": round(busy / wall, 4) if wall else None,
             }
         st = service.coalescer().stats()
@@ -1370,10 +1398,21 @@ def _bench_body(_obs_reg):
         "vs_baseline": round(headline / cpu_rate, 3),
         "headline_rung": headline_rung,
     }
+    # environment fingerprint: every detail blob (and trend record)
+    # says WHERE it was measured, so a cross-host comparison can
+    # refuse instead of flagging hardware differences as regressions
+    env = None
+    try:
+        from jepsen_tpu.obs import trend as obs_trend
+        env = obs_trend.fingerprint()
+        obs_trend.record(rungs, fp=env, label="bench")
+    except Exception:  # noqa: BLE001 - the headline must print
+        pass
     # detail first, short headline-only line LAST: the driver captures
     # the output's tail, and the detail blob once pushed the headline
     # fields out of it (BENCH_r04 "parsed": null)
     print(json.dumps({**head, "detail": rungs,
+                      "environment": env,
                       # whole-bench scope: includes the compile
                       # warm-up dispatches the timed rungs exclude, so
                       # chunk_s tails here overstate the measured runs

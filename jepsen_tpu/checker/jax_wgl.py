@@ -57,6 +57,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..history import INF_TIME
+from ..obs import phases as obs_phases
 from ..obs import search as obs_search
 
 INF32 = np.int32(2**31 - 1)
@@ -898,12 +899,15 @@ def _n_floor():
 
 def _note_compile(engine, key):
     """Report this search's compile plan to the campaign-level
-    compile-reuse ledger (hit/miss counters; never verdict-bearing)."""
+    compile-reuse ledger (hit/miss counters; never verdict-bearing).
+    Returns True when the ledger calls it a MISS — the phase plane
+    attributes the next dispatch's wall to XLA compile, not
+    device-compute."""
     try:
         from ..campaign import compile_cache
-        compile_cache.note(engine, key)
+        return not compile_cache.note(engine, key)
     except Exception:  # noqa: BLE001 - telemetry only
-        pass
+        return False
 
 
 def _adapt_quantum(cap, per_it, target_s, left_s=None):
@@ -1172,19 +1176,24 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
     resumes from the snapshot instead of restarting; snapshots carry a
     fingerprint of the search inputs so a stale file for a different
     history or plan is ignored."""
+    # phase cursor (obs.phases): attributes this search's wall to
+    # encode/plan/h2d/compile/device/d2h/host spans; a pair of clock
+    # reads per lap when obs is unbound
+    ph = obs_phases.capture("jax-wgl")
     prep = _prepare_search(spec, e, init_state, confirm)
     if prep[0] == "fast":
         return prep[1]
     (perm, inv32, ret32, fop, args, rets, ok_words, init_state, n_pad,
      C, A, S) = prep[1]
+    ph.lap("encode")
 
     B, W, O, T = _plan_sizes(n_pad, S, C, frontier_width, stack_size,
                              table_size)
     # cross-run compile-reuse ledger: everything feeding _build_search's
     # lru/jit key must feed this key too, or a "hit" could lie
-    _note_compile("jax-wgl", (spec.name, n_pad, B, S, C, A, W, O, T,
-                              rollout_kernel, rollout_seeds,
-                              rollout_depth))
+    ph.note_compile(_note_compile(
+        "jax-wgl", (spec.name, n_pad, B, S, C, A, W, O, T,
+                    rollout_kernel, rollout_seeds, rollout_depth)))
     # honor tiny explicit budgets (a 1-iteration run must bail after 1
     # iteration, not 64 -- the checkpoint tests rely on it); the default
     # 50M-config budget keeps max_iters far above any real search
@@ -1194,11 +1203,14 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
                                           W, O, T, R=rollout_depth,
                                           NS=rollout_seeds,
                                           rollout_kernel=rollout_kernel)
+    ph.lap("plan")
     consts = (jnp.asarray(inv32[None]), jnp.asarray(ret32[None]),
               jnp.asarray(fop[None]), jnp.asarray(args[None]),
               jnp.asarray(rets[None]), jnp.asarray(ok_words[None]),
               jnp.zeros(1, jnp.uint32))
     carry = init_carry(jnp.asarray(init_state[None]))
+    ph.sync(carry)
+    ph.lap("h2d")
     import time as _time
     fingerprint = None
     if checkpoint is not None:
@@ -1250,7 +1262,13 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
         prev_it = it
         t_chunk = _time.monotonic()
         bound = min(it + eff, max_iters)
+        ph.lap("host")
         carry = run_chunk(carry, *consts, jnp.int32(bound))
+        # device-compute bracket: the sync exists ONLY while phase
+        # attribution is on (otherwise the progress device_get below
+        # stays the dispatch's one sync, as before)
+        ph.sync(carry)
+        dev_s = ph.lap("device", iteration=it)
         # ONE host round-trip for the whole progress tensor (separate
         # device_gets cost ~0.2 s each over the remote-TPU tunnel; see
         # table_stats): status/top/it/explored scalars plus the TOPK
@@ -1262,6 +1280,7 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
              carry[IDX_BEST_DEPTH][0]))
         status, top, it, explored = (int(status), int(top), int(it),
                                      int(explored))
+        ph.lap("d2h")
         # heartbeat per dispatch: long searches stop being a silent jit
         # black box (frontier depth + cumulative explored + deepest op
         # reached, streamed to the captured tracer/registry; no-op when
@@ -1269,7 +1288,8 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
         # everything rides the batched device_get above)
         so.heartbeat(
             "jax-wgl", iteration=it,
-            chunk_s=_time.monotonic() - t_chunk, frontier=top,
+            chunk_s=_time.monotonic() - t_chunk,
+            device_s=dev_s if ph.enabled else None, frontier=top,
             explored=explored,
             depth=max(0, int(np.asarray(bdepth).max())))
         if status != RUNNING or top == 0 or it >= max_iters:
@@ -1290,6 +1310,7 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
                 _save_checkpoint(checkpoint, fingerprint, carry)
             break
 
+    ph.lap("host")
     out = {"status": carry[IDX_STATUS][0], "top": carry[IDX_TOP][0],
            "dropped": carry[IDX_DROPPED][0],
            "explored": carry[IDX_EXPLORED][0],
@@ -1299,6 +1320,7 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
            "best_state": carry[IDX_BEST_STATE][0]}
     out = jax.device_get(out)
     tstats = table_stats(carry)
+    ph.lap("d2h")
     if timed_out and int(out["status"]) == RUNNING and int(out["top"]) > 0:
         result = {"valid": "unknown", "error": "timeout",
                   "configs_explored": int(out["explored"]),
@@ -1306,11 +1328,13 @@ def check_encoded(spec, e, init_state, max_configs=50_000_000,
                   "engine": "jax-wgl", **tstats,
                   **({"checkpoint": checkpoint} if checkpoint else {})}
         so.summary("jax-wgl", result)
+        ph.lap("host")
         return result
     result = _interpret(spec, e, out, max_iters, confirm, init_state,
                         perm)
     result.update(tstats)
     so.summary("jax-wgl", result)
+    ph.lap("host")
     # never clobber a snapshot that belongs to a DIFFERENT check (the
     # mismatched-fingerprint case the load guard already ignores)
     if checkpoint is not None and _checkpoint_owned(checkpoint,

@@ -267,6 +267,10 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     # PL018 (knob half): an unknown --fleetlint value is an error
     # here, not a silently-skipped audit
     diags += planlint.lint_fleetlint({"fleetlint": fleetlint})
+    # PL022: phase-attribution / trend-gate knobs ride along like
+    # PL019 (phases off while profile or a bubble fold needs their
+    # spans, unreadable trend baselines, bad gate thresholds)
+    diags += planlint.lint_trend(base_options)
     # PL020: cross-tenant coalescing knobs ride along like the other
     # serve knobs (the CLI co-launches the service; bad windows and
     # no-op configurations surface before any host is contacted)
@@ -1036,6 +1040,25 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
             jr.write_report(report)
         except Exception:  # noqa: BLE001
             logger.warning("couldn't fold campaign metrics",
+                           exc_info=True)
+        # fold the merged trace's phase spans into the idle-bubble
+        # ledger (byte-deterministic bubble_ledger.json) and put the
+        # attribution headline on the report next to the padding /
+        # duty-cycle numbers. Needs the merged trace; contained the
+        # same way
+        try:
+            from ..obs import bubbles as obs_bubbles
+            ledger = obs_bubbles.fold_campaign(campaign_id)
+            if ledger.get("episodes"):
+                report.setdefault("introspection", {})
+                report["introspection"]["bubbles"] = {
+                    k: ledger.get(k)
+                    for k in ("device_s", "idle_s", "attributed_s",
+                              "attribution_frac", "residual_s",
+                              "path")}
+                jr.write_report(report)
+        except Exception:  # noqa: BLE001
+            logger.warning("couldn't fold the bubble ledger",
                            exc_info=True)
         jr.write_meta({**(jr.load_meta() or {}),
                        "status": "aborted" if aborted else "complete",

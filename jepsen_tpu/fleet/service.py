@@ -65,6 +65,7 @@ import threading
 import time
 
 from .. import robust, store
+from ..obs import phases as obs_phases
 
 logger = logging.getLogger(__name__)
 
@@ -659,6 +660,12 @@ class Coalescer:
                 reg.observe("service.coalesce.wait_s",
                             t_dispatch - it.enqueued,
                             buckets=SLO_BUCKETS_S)
+                # the queue wait is also a named phase in the
+                # time-attribution plane (obs.phases): idle the bubble
+                # ledger books against "wait", not mystery residual
+                obs_phases.note_wait("jax-wgl-batch",
+                                     t_dispatch - it.enqueued,
+                                     owner=it.owner)
         except Exception:  # noqa: BLE001
             logger.warning("coalesce accounting failed", exc_info=True)
 

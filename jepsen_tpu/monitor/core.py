@@ -33,6 +33,7 @@ import time as _time
 from .. import independent
 from .. import obs
 from .. import robust
+from ..obs import phases as obs_phases
 from ..checker.core import merge_valid
 from . import engine as mengine
 from .stream import StreamEncoder
@@ -297,6 +298,11 @@ class Monitor:
             if self._reg is not None:
                 self._reg.observe("monitor.device_wait_s",
                                   _time.monotonic() - t_w)
+            # device-slot wait is a named phase in the attribution
+            # plane: the engine's own phase spans start only once the
+            # semaphore admits the check
+            obs_phases.note_wait(self.engine,
+                                 _time.monotonic() - t_w)
         try:
             with self._span("monitor.check", key=repr(key), n=len(e)):
                 r = mengine.check_prefix(

@@ -186,7 +186,7 @@ def run_scope(test):
     tr = Tracer(context=ctx)
     reg = Registry(default_labels=ctx)
     test["obs"] = {"tracer": tr, "registry": reg}
-    cfg = {k: test[k] for k in ("progress-interval-s",)
+    cfg = {k: test[k] for k in ("progress-interval-s", "phases?")
            if test.get(k) is not None}
 
     @contextlib.contextmanager

@@ -328,19 +328,28 @@ def fold_campaign_metrics(campaign_id, persist=True):
 
 def introspection_summary(fold, makespan_s=None):
     """The device-introspection headline from a metrics fold (or any
-    snapshot dict): the per-bucket padding-waste table and the device
-    duty cycle.
+    snapshot dict): the per-bucket padding-waste table, the device
+    duty cycle, and the phase breakdown.
 
     * ``padding``: {bucket: {real, padded, waste_frac}} summed over
       engines — how many padded batch rows per power-of-two n-bucket
       were real ops vs inert lanes.
-    * ``device_busy_s``: summed per engine; ``duty_cycle`` = total
-      busy wall / ``makespan_s`` when the caller knows the campaign
-      makespan (the trace summary does)."""
+    * ``device_busy_s``: summed per engine (device-COMPUTE wall — the
+      obs.phases bracket — when phase attribution ran, else the full
+      chunk wall); ``duty_cycle`` = total busy wall / ``makespan_s``
+      when the caller knows the campaign makespan (the trace summary
+      does).
+    * ``chunk_s``: per-engine SUM of the host-side dispatch-chunk
+      wall (the ``wgl.chunk_s`` histogram — the pre-phase meaning of
+      "busy"); busy <= chunk always, and the gap is the per-dispatch
+      transfer/harvest overhead.
+    * ``phase_s``: {engine: {phase: s}} from the ``wgl.phase_s``
+      counters — where the non-device wall went."""
     from .metrics import parse_flat_key
     counters = (fold or {}).get("counters") or {}
     buckets = {}
     busy = {}
+    phases = {}
     for k, v in counters.items():
         name, labels = parse_flat_key(k)
         if name in ("wgl.cells_real", "wgl.cells_padded"):
@@ -350,6 +359,18 @@ def introspection_summary(fold, makespan_s=None):
         elif name == "wgl.device_busy_s":
             eng = labels.get("engine") or "?"
             busy[eng] = busy.get(eng, 0.0) + float(v)
+        elif name == "wgl.phase_s":
+            eng = labels.get("engine") or "?"
+            p = labels.get("phase") or "?"
+            ep = phases.setdefault(eng, {})
+            ep[p] = ep.get(p, 0.0) + float(v)
+    chunk = {}
+    for k, h in ((fold or {}).get("histograms") or {}).items():
+        name, labels = parse_flat_key(k)
+        if name == "wgl.chunk_s" and isinstance(h, dict):
+            eng = labels.get("engine") or "?"
+            chunk[eng] = chunk.get(eng, 0.0) + float(h.get("sum")
+                                                     or 0.0)
     for st in buckets.values():
         total = st["real"] + st["padded"]
         st["waste_frac"] = round(st["padded"] / total, 4) if total \
@@ -360,6 +381,13 @@ def introspection_summary(fold, makespan_s=None):
            "device_busy_s": {e: round(s, 3)
                              for e, s in sorted(busy.items())},
            "device_busy_total_s": round(sum(busy.values()), 3)}
+    if chunk:
+        out["chunk_s"] = {e: round(s, 3)
+                          for e, s in sorted(chunk.items())}
+    if phases:
+        out["phase_s"] = {e: {p: round(s, 3)
+                              for p, s in sorted(ep.items())}
+                          for e, ep in sorted(phases.items())}
     if makespan_s and makespan_s > 0:
         out["duty_cycle"] = round(sum(busy.values()) / makespan_s, 4)
     return out

@@ -1,0 +1,170 @@
+"""Per-dispatch phase attribution for the device WGL host loops.
+
+PR 13's introspection plane answers "is the search moving?" but not
+"where does the wall go?": ``wgl.device_busy_s`` bracketed the whole
+host-side dispatch chunk, so transfer, compile, and host expansion were
+invisible inside the "busy" number. This module splits every device
+dispatch — in the single-key loop (checker/jax_wgl.py), the key batch
+(parallel/keyshard.py), the mesh shard (parallel/searchshard.py), and
+the coalescer/monitor paths that ride them — into named phase spans:
+
+========  ===========================================================
+phase     covers
+========  ===========================================================
+encode    history -> op-table encoding, fast paths, pruning
+plan      bucket/size planning, kernel build, compile-ledger note
+h2d       host->device transfer of op columns and the initial carry
+compile   the first device dispatch after a compile-ledger MISS (its
+          wall is dominated by XLA compile, not stepping)
+device    the device-compute bracket proper: dispatch ->
+          ``block_until_ready`` on the donated carry
+d2h       the batched progress ``device_get`` + final harvest reads
+host      everything else on the host between dispatches: heartbeat
+          bookkeeping, quantum adaptation, expansion/dedup of
+          results, batch compaction rebuilds, verdict interpretation
+wait      slot/queue wait (coalescer queue latency, the monitor's
+          device-semaphore acquisition) — emitted via `note_wait`
+========  ===========================================================
+
+Each lap lands twice: a ``cat="phase"`` complete span on the trace
+(``wgl.phase.<name>``, so obs/bubbles.py can walk a lane and classify
+every idle gap) and a ``wgl.phase_s{phase,engine}`` counter in the
+registry (so the campaign fold and ``/api/metrics`` carry the same
+breakdown without a trace in hand).
+
+The session is a CURSOR, not a stack: ``lap(name)`` attributes all
+wall since the previous lap/mark to ``name`` and advances the cursor,
+so consecutive spans are exactly contiguous and non-overlapping by
+construction — the invariant the bubble ledger's >=95% attribution
+target rests on. The cursor lives in ``monotonic_ns`` and is mapped
+onto the tracer's clock through one constant offset captured at
+session start, so contiguity survives the conversion exactly.
+
+Cost discipline: when obs is unbound (or the run sets ``phases?:
+false``) a session is two ``monotonic_ns`` reads per lap and the
+engines skip the extra ``block_until_ready`` sync entirely — the
+dispatch loops' own device syncs dominate regardless.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+from . import current_sinks, run_config
+
+__all__ = ["PHASES", "CAT", "METRIC", "capture", "note_wait",
+           "PhaseSession"]
+
+#: the closed phase vocabulary (PL022 and the bubble fold key off it)
+PHASES = ("encode", "plan", "h2d", "compile", "device", "d2h", "host",
+          "wait")
+
+#: trace category of every phase span (the bubble fold's filter)
+CAT = "phase"
+
+#: registry counter: seconds per {phase, engine}
+METRIC = "wgl.phase_s"
+
+
+def capture(engine):
+    """Snapshot this context's sinks into a phase session for one
+    search. Honors the run's ``phases?`` knob (default on whenever obs
+    is bound): a disabled session measures nothing extra and emits
+    nothing."""
+    tr, reg = current_sinks()
+    if run_config().get("phases?") is False:
+        tr = reg = None
+    return PhaseSession(engine, tr, reg)
+
+
+def note_wait(engine, wait_s, **args):
+    """Emit ONE slot/queue-wait span ending now against the caller's
+    current sinks: the coalescer's enqueue->dispatch latency, the
+    monitor's device-semaphore wait. These phases are measured by
+    their owners (the wait brackets code outside any engine's
+    session), so they enter the attribution plane through this module
+    function instead of a session lap."""
+    tr, reg = current_sinks()
+    if run_config().get("phases?") is False:
+        return
+    try:
+        wait_s = max(0.0, float(wait_s))
+    except (TypeError, ValueError):
+        return
+    if reg is not None:
+        reg.inc(METRIC, wait_s, phase="wait", engine=engine)
+    if tr is not None:
+        dur_ns = int(wait_s * 1e9)
+        tr.complete("wgl.phase.wait", max(0, tr.now_ns() - dur_ns),
+                    dur_ns, cat=CAT,
+                    args={"engine": engine, **args})
+
+
+class PhaseSession:
+    """One search's phase cursor (see module docstring).
+
+    ``totals`` accumulates seconds per phase for the session —
+    engines fold it into their result diagnostics and tests pin the
+    contiguity invariants against it."""
+
+    def __init__(self, engine, tr, reg):
+        self.engine = engine
+        self._tr = tr
+        self._reg = reg
+        self.enabled = tr is not None or reg is not None
+        self._cursor = _time.monotonic_ns()
+        # constant monotonic->tracer clock offset: applied to every
+        # span start so consecutive laps stay EXACTLY contiguous
+        self._off = (tr.now_ns() - _time.monotonic_ns()) \
+            if tr is not None else 0
+        self._compile_pending = False
+        self.totals = {}
+
+    def note_compile(self, miss):
+        """Arm the compile phase: the NEXT device lap is attributed to
+        ``compile`` instead (the compile-ledger said this shape was
+        never traced in this process, so that dispatch's wall is XLA's,
+        not the kernel's). Hits arm nothing."""
+        if miss:
+            self._compile_pending = True
+
+    def mark(self):
+        """Reset the cursor to now, dropping the wall since the last
+        lap from attribution (used only at session start)."""
+        self._cursor = _time.monotonic_ns()
+
+    def lap(self, phase, **args):
+        """Attribute all wall since the previous lap/mark to ``phase``
+        and advance the cursor. Returns the lap's seconds (measured
+        even when disabled, so callers can reuse the number)."""
+        now = _time.monotonic_ns()
+        d_ns = now - self._cursor
+        ts_ns = self._cursor + self._off
+        self._cursor = now
+        if d_ns < 0:
+            return 0.0
+        dt = d_ns / 1e9
+        if not self.enabled:
+            return dt
+        if phase == "device" and self._compile_pending:
+            phase = "compile"
+            self._compile_pending = False
+        self.totals[phase] = self.totals.get(phase, 0.0) + dt
+        if self._reg is not None:
+            self._reg.inc(METRIC, dt, phase=phase, engine=self.engine)
+        if self._tr is not None:
+            self._tr.complete(f"wgl.phase.{phase}", ts_ns, d_ns,
+                              cat=CAT,
+                              args={"engine": self.engine, **args})
+        return dt
+
+    def sync(self, *arrays):
+        """``block_until_ready`` the given device values — but ONLY
+        when the session is enabled: with phases off the dispatch loop
+        keeps its original async shape (the progress ``device_get``
+        remains the only sync) and pays nothing."""
+        if self.enabled:
+            import jax
+            for a in arrays:
+                if a is not None:
+                    jax.block_until_ready(a)

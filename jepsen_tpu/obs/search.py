@@ -13,7 +13,10 @@ search (parallel/searchshard.py) — one cheap call per dispatch:
 * `heartbeat()` emits an instant trace event + counter tracks
   (frontier depth, states explored, deepest linearized op, keys still
   running, shard balance), updates gauges, and accumulates the
-  device-busy wall (`wgl.device_busy_s` — the duty-cycle numerator),
+  device-busy wall (`wgl.device_busy_s` — the duty-cycle numerator:
+  the device-compute phase bracket when obs.phases measured one for
+  the dispatch, else the full chunk wall, whose per-dispatch
+  distribution `wgl.chunk_s` keeps either way),
   so a stalled search is diagnosable mid-flight from trace.jsonl and
   a live scrape of ``GET /api/metrics`` shows monotonically-increasing
   explored/frontier series mid-search;
@@ -96,7 +99,11 @@ class SearchObs:
             self._min_interval = max(0.0, float(min_interval_s or 0.0))
         except (TypeError, ValueError):
             self._min_interval = 0.0
-        self._last_emit = 0.0
+        # None until the first emission: 0.0 would throttle the
+        # FIRST heartbeat on a freshly-booted host (monotonic()
+        # counts from boot, so now - 0.0 can sit under a long
+        # interval for the machine's first hours)
+        self._last_emit = None
 
     def enabled(self):
         return self._tr is not None or self._reg is not None
@@ -135,17 +142,20 @@ class SearchObs:
                 fields["owners"] = int(owners)
             tr.instant(f"wgl.plan.{engine}", cat="search", args=fields)
 
-    def heartbeat(self, engine, iteration, chunk_s, frontier=None,
-                  explored=None, depth=None, keys_alive=None,
-                  keys_running=None, compactions=None, shard_tops=None,
-                  **extra):
+    def heartbeat(self, engine, iteration, chunk_s, device_s=None,
+                  frontier=None, explored=None, depth=None,
+                  keys_alive=None, keys_running=None, compactions=None,
+                  shard_tops=None, **extra):
         """One call per host→device dispatch. ``frontier`` is the DFS
         stack depth (scalar, or summed over keys), ``explored`` the
         cumulative states-explored counter, ``depth`` the deepest
         linearized-ok-op count reached so far (the "wedged at op K
         with frontier F" watchdog signal — progress toward n_ok),
         ``shard_tops`` the per-shard frontier sizes (the steal-ring
-        balance signal)."""
+        balance signal). ``device_s`` is the device-compute bracket
+        (the phase plane's ``block_until_ready`` measurement): when
+        given, it — not the full chunk wall — feeds the duty-cycle
+        numerator."""
         tr, reg = self._tr, self._reg
         if tr is None and reg is None:
             return
@@ -153,11 +163,18 @@ class SearchObs:
             reg.inc("wgl.chunks", engine=engine)
             reg.observe("wgl.chunk_s", chunk_s,
                         buckets=CHUNK_BUCKETS_S, engine=engine)
-            # duty-cycle numerator: device-busy wall accumulated per
-            # dispatch (the sync rides the dispatch, so chunk_s IS the
-            # device-occupancy bound the host loop observed)
-            reg.inc("wgl.device_busy_s", float(chunk_s), engine=engine)
+            # duty-cycle numerator: the DEVICE-COMPUTE wall when the
+            # engine measured one (obs.phases bracket), else the full
+            # chunk wall (phase attribution off: the dispatch's sync
+            # rides the progress device_get, so chunk_s is the only
+            # device-occupancy bound available — the pre-phase
+            # behavior). Either way busy <= the wgl.chunk_s sum.
+            reg.inc("wgl.device_busy_s",
+                    float(device_s if device_s is not None
+                          else chunk_s), engine=engine)
         fields = {"iteration": iteration, "chunk_s": round(chunk_s, 4)}
+        if device_s is not None:
+            fields["device_s"] = round(float(device_s), 4)
         track = {}
         if frontier is not None:
             fields["frontier"] = track["frontier"] = int(frontier)
@@ -202,8 +219,8 @@ class SearchObs:
         # above is already current, so skipping the disk-touching
         # tail only coarsens the TRACE's sampling of it
         now = _time.monotonic()
-        if self._min_interval and now - self._last_emit \
-                < self._min_interval:
+        if self._min_interval and self._last_emit is not None \
+                and now - self._last_emit < self._min_interval:
             return
         self._last_emit = now
         if tr is not None:

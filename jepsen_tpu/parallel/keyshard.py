@@ -38,6 +38,7 @@ from ..checker.jax_wgl import (IDX_BEST_DEPTH, IDX_BEST_LIN,
                                _encode_arrays, _plan_sizes,
                                max_point_concurrency, table_stats)
 from ..history import INF_TIME
+from ..obs import phases as obs_phases
 from ..obs import search as obs_search
 
 logger = logging.getLogger(__name__)
@@ -152,6 +153,9 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     if K_real == 0:
         return []
 
+    # phase cursor (obs.phases): per-dispatch encode/plan/h2d/compile/
+    # device/d2h/host attribution for the batch loop
+    ph = obs_phases.capture("jax-wgl-batch")
     results = [None] * K_real
     live = []
     encs = {}
@@ -172,6 +176,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         live.append(k)
     if not live:
         return results
+    ph.lap("encode")
 
     # common bucket sizes across live keys (the op-count floor is the
     # campaign-tunable shared bucket, jax_wgl._n_floor; a caller may
@@ -239,10 +244,11 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     # cross-run compile-reuse ledger (campaign.compile_cache): the key
     # mirrors the initial _build_search lru/jit key; compaction
     # rebuilds mid-search are not separately accounted
-    jax_wgl._note_compile(
+    ph.note_compile(jax_wgl._note_compile(
         "jax-wgl-batch",
         (spec.name, K, W, n_pad, B, S_pad, C, A, O, T, G, R_batch,
-         rollout_seeds, mesh is not None))
+         rollout_seeds, mesh is not None)))
+    ph.lap("plan")
     perms = [c[7] for c in cols]          # host-only: witness decoding
     consts = tuple(jnp.asarray(np.stack([c[i] for c in cols]))
                    for i in range(7)) + (jnp.asarray(np.asarray(salts)),)
@@ -339,6 +345,8 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         alive = [j if j < len(live) else -1 for j in range(K)]
         harvested = {}
         it = 0
+    ph.sync(carry)
+    ph.lap("h2d")
     t0 = _time.monotonic()
     last_ckpt = t0
     timed_out = False
@@ -371,7 +379,9 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
                   "best_depth": carry[IDX_BEST_DEPTH],
                   "best_lin": carry[IDX_BEST_LIN],
                   "best_state": carry[IDX_BEST_STATE]}
+        ph.lap("host")
         got = jax.device_get(fields)
+        ph.lap("d2h")
         for r in rows:
             if alive[r] >= 0:
                 harvested[alive[r]] = {k: np.asarray(v)[r]
@@ -381,7 +391,13 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         bound = min(it + eff_chunk, max_iters)
         t_chunk = _time.monotonic()
         prev_it = it
+        ph.lap("host")
         carry = run_b(carry, *consts, jnp.int32(bound))
+        # device-compute bracket: sync only while phase attribution is
+        # on (the progress device_get below stays the sole sync
+        # otherwise, as before)
+        ph.sync(carry)
+        dev_s = ph.lap("device", iteration=bound)
         it = bound
         # the dispatch returns asynchronously: sync on ONE batched
         # device_get of the whole progress tensor BEFORE measuring the
@@ -396,6 +412,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
             (carry[IDX_STATUS], carry[IDX_TOP], carry[IDX_ITS],
              carry[IDX_EXPLORED], carry[IDX_BEST_DEPTH]))
         status = np.asarray(status)
+        ph.lap("d2h")
         now = _time.monotonic()
         per_it = max(1e-4, (now - t_chunk) / max(1, it - prev_it))
         # chunk granularity shrinks as the live batch width grows or
@@ -429,6 +446,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
         so.heartbeat(
             "jax-wgl-batch", iteration=it,
             chunk_s=_time.monotonic() - t_chunk,
+            device_s=dev_s if ph.enabled else None,
             frontier=int(top.sum()),
             explored=sum(int(explored_k[r])
                          for r in range(len(alive)) if alive[r] >= 0)
@@ -502,7 +520,9 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
     # the dedup table is shared across keys (key-salted), so occupancy
     # diagnostics are batch-wide: the same numbers go on every searched
     # key's result (summed over table groups under a mesh)
+    ph.lap("host")
     tstats = table_stats(carry)
+    ph.lap("d2h")
     for j, k in enumerate(live):
         per = harvested[j]
         if (timed_out and int(per["status"]) == RUNNING
@@ -531,6 +551,7 @@ def check_batch_encoded(spec, pairs, max_configs=50_000_000,
                  default=0),
              **tstats},
             keys=len(live))
+    ph.lap("host")
     return results
 
 

@@ -57,6 +57,7 @@ from ..checker.jax_wgl import (IDX_BEST_DEPTH, IDX_BEST_LIN,
                                IDX_BEST_STATE, IDX_DROPPED, IDX_EXPLORED,
                                IDX_IT, IDX_ITS, IDX_STATUS, IDX_TOP,
                                RUNNING, VALID, _build_search, _plan_sizes)
+from ..obs import phases as obs_phases
 from ..obs import search as obs_search
 from .keyshard import _shard_specs, shard_map_compat
 
@@ -74,14 +75,23 @@ def check_encoded_sharded(spec, e, init_state, mesh,
     dict matches jax_wgl.check_encoded, plus per-shard diagnostics
     (``shard_explored``) proving the steal ring spread the work."""
     D = int(mesh.shape[mesh.axis_names[0]])
+    # phase cursor (obs.phases): per-dispatch encode/plan/h2d/compile/
+    # device/d2h/host attribution for the mesh loop
+    ph = obs_phases.capture("jax-wgl-sharded")
     prep = jax_wgl._prepare_search(spec, e, init_state)
     if prep[0] == "fast":
         return prep[1]
     (perm, inv32, ret32, fop, args, rets, ok_words, init_state, n_pad,
      C, A, S) = prep[1]
+    ph.lap("encode")
 
     B, W, O, T = _plan_sizes(n_pad, S, C, frontier_width, stack_size,
                              table_size)
+    # cross-run compile-reuse ledger: mirrors the _build_search keys
+    # below (both the local kernel and the init builder feed them)
+    ph.note_compile(jax_wgl._note_compile(
+        "jax-wgl-sharded", (spec.name, D, n_pad, B, S, C, A, W, O, T,
+                            steal, rollout_seeds)))
     max_iters = max(1, max_configs // (W * D))
 
     # the local kernel: ONE shard of the search (K=1, its own table
@@ -96,6 +106,7 @@ def check_encoded_sharded(spec, e, init_state, mesh,
         run_local.__wrapped__, mesh,
         (carry_specs,) + const_specs, carry_specs),
         donate_argnums=(0,))
+    ph.lap("plan")
 
     # global init: the builder's init_carry for K=D shards, then only
     # shard 0 keeps the root configuration (symmetric shards would
@@ -118,6 +129,8 @@ def check_encoded_sharded(spec, e, init_state, mesh,
                                            col.ndim)), shd)
         for col in (inv32, ret32, fop, args, rets, ok_words)) + (
         jax.device_put(jnp.zeros(D, jnp.uint32), shd),)
+    ph.sync(carry)
+    ph.lap("h2d")
 
     t0 = _time.monotonic()
     timed_out = False
@@ -133,7 +146,13 @@ def check_encoded_sharded(spec, e, init_state, mesh,
         prev_it = it
         t_chunk = _time.monotonic()
         bound = min(it + eff, max_iters)
+        ph.lap("host")
         carry = run_b(carry, *consts, jnp.int32(bound))
+        # device-compute bracket: sync only while phase attribution is
+        # on (otherwise the progress device_get below stays the
+        # dispatch's one sync, as before)
+        ph.sync(carry)
+        dev_s = ph.lap("device", iteration=bound)
         # ONE batched device_get of the progress tensor (replacing the
         # three separate per-array transfers): per-shard status/top,
         # the iteration counter, cumulative explored, and the witness
@@ -144,6 +163,7 @@ def check_encoded_sharded(spec, e, init_state, mesh,
         status = np.asarray(status)
         top = np.asarray(top)
         it = int(np.asarray(it_g)[0])
+        ph.lap("d2h")
         # per-shard frontier sizes ARE the steal-ring balance signal:
         # all work stuck on one shard = the ring is starved. Built from
         # the arrays this poll already fetched — no extra per-chunk
@@ -151,6 +171,7 @@ def check_encoded_sharded(spec, e, init_state, mesh,
         so.heartbeat(
             "jax-wgl-sharded", iteration=it,
             chunk_s=_time.monotonic() - t_chunk,
+            device_s=dev_s if ph.enabled else None,
             frontier=int(top.sum()),
             explored=int(np.asarray(explored_d).sum()),
             depth=max(0, int(np.asarray(bdepth).max())),
@@ -168,6 +189,7 @@ def check_encoded_sharded(spec, e, init_state, mesh,
             timed_out = True
             break
 
+    ph.lap("host")
     got = jax.device_get({
         "status": carry[IDX_STATUS], "top": carry[IDX_TOP],
         "dropped": carry[IDX_DROPPED], "explored": carry[IDX_EXPLORED],
@@ -176,6 +198,7 @@ def check_encoded_sharded(spec, e, init_state, mesh,
         "best_lin": carry[IDX_BEST_LIN],
         "best_state": carry[IDX_BEST_STATE]})
     tstats = jax_wgl.table_stats(carry)
+    ph.lap("d2h")
     status = np.asarray(got["status"])
     top = np.asarray(got["top"])
     explored = np.asarray(got["explored"])
@@ -188,6 +211,7 @@ def check_encoded_sharded(spec, e, init_state, mesh,
     def _done(result):
         so.summary("jax-wgl-sharded", result,
                    shard_explored=result["shard_explored"])
+        ph.lap("host")
         return result
 
     if (status == VALID).any():

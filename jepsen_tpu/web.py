@@ -264,6 +264,56 @@ def _waste_table(cid):
             f"<tbody>{rows}</tbody></table>")
 
 
+def _phase_rows_html(summary):
+    """Per-engine phase-breakdown table rows from an
+    introspection_summary dict; "" when no phase accounting exists."""
+    phase_s = (summary or {}).get("phase_s")
+    if not phase_s:
+        return ""
+    rows = []
+    for eng, per in sorted(phase_s.items()):
+        total = sum(per.values()) or 1.0
+        for p, s in sorted(per.items(), key=lambda kv: -kv[1]):
+            rows.append(
+                f"<tr><td>{html.escape(eng)}</td>"
+                f"<td>{html.escape(p)}</td><td>{s:.3f}</td>"
+                f"<td>{s / total * 100:.1f}%</td></tr>")
+    return ("<h3>Where the time goes (per-dispatch phases)</h3>"
+            "<table><thead><tr><th>Engine</th><th>Phase</th>"
+            "<th>Seconds</th><th>Share</th></tr></thead>"
+            f"<tbody>{''.join(rows)}</tbody></table>")
+
+
+def _phase_table(cid):
+    """The campaign's phase-breakdown table (obs.phases attribution
+    folded across cells) plus the bubble-ledger headline when
+    finalize wrote one; "" when the campaign has neither."""
+    out = ""
+    try:
+        with open(store.campaign_path(cid, "metrics_fold.json")) as f:
+            fold = json.load(f)
+        from .obs.merge import introspection_summary
+        out += _phase_rows_html(introspection_summary(fold))
+    except Exception:  # noqa: BLE001 - the page must render
+        pass
+    try:
+        with open(store.campaign_path(cid, "bubble_ledger.json")) as f:
+            led = json.load(f)
+        if led.get("episodes"):
+            out += (
+                "<p>idle bubbles: "
+                f"{led.get('device_s', 0.0):.3f}s device-compute, "
+                f"{led.get('idle_s', 0.0):.3f}s idle, "
+                f"{led.get('attribution_frac', 0.0) * 100:.1f}% "
+                "attributed &mdash; "
+                f'<a href="/files/{store.CAMPAIGNS_DIR}/'
+                f'{urllib.parse.quote(cid)}/bubble_ledger.json">'
+                "bubble_ledger.json</a></p>")
+    except Exception:  # noqa: BLE001 - the page must render
+        pass
+    return out
+
+
 def _campaigns_page():
     """Campaign index: one section per campaign, its runs grouped by
     cell (web's view of store/campaigns/<id>/). Fleet campaigns
@@ -344,6 +394,7 @@ def _campaigns_page():
             f"{len(records)}/{planned} cells ({html.escape(badge)})"
             f"{audit_line}{trace_link}{capacity_link}</p>{util_table}"
             f"{_capacity_table(data)}{_waste_table(cid)}"
+            f"{_phase_table(cid)}"
             f"<table><thead><tr><th>Cell</th><th>Outcome</th>"
             f"<th>Valid?</th><th>Run</th><th>Wall (s)</th></tr></thead>"
             f"<tbody>{''.join(rows)}</tbody></table>")
@@ -376,8 +427,19 @@ def _dir_page(rel, full):
         else:
             banner = (f"<p>monitor: {html.escape(str(mon['verdict']))}"
                       "</p>")
+    # per-run phase breakdown: a run dir with metrics.json gets the
+    # same where-the-time-goes table the campaign page renders
+    phase_panel = ""
+    if "metrics.json" in entries:
+        try:
+            with open(os.path.join(full, "metrics.json")) as f:
+                m = json.load(f)
+            from .obs.merge import introspection_summary
+            phase_panel = _phase_rows_html(introspection_summary(m))
+        except Exception:  # noqa: BLE001 - the page must render
+            pass
     return f"""<html><head><style>{STYLE}</style></head><body>
-<h1>/{html.escape(rel)}</h1>{banner}<ul>{''.join(items)}</ul>
+<h1>/{html.escape(rel)}</h1>{banner}{phase_panel}<ul>{''.join(items)}</ul>
 </body></html>"""
 
 

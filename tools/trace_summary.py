@@ -139,6 +139,16 @@ def summarize(store_dir):
                           - min(e.get("ts", 0.0) for e in xs)) / 1e6
         lines += _introspection_lines(metrics, run_wall_s)
 
+    if events:
+        try:
+            from jepsen_tpu.obs.bubbles import fold_events
+            lines += _bubble_lines(fold_events(events))
+        except Exception:  # noqa: BLE001 - the summary must print
+            pass
+
+    if metrics:
+        counters = metrics.get("counters", {})
+
         mon = {k: v for k, v in sorted(counters.items())
                if k.startswith("monitor.")}
         mon.update({k: v for k, v in
@@ -188,10 +198,15 @@ def _introspection_lines(metrics_like, wall_s=None):
                          f"{st['padded']:>10}  "
                          f"{st['waste_frac'] * 100:5.1f}%")
     busy = summary.get("device_busy_s") or {}
+    chunk = summary.get("chunk_s") or {}
     if busy:
         lines.append("\n-- device duty cycle --")
         for eng, s in busy.items():
-            lines.append(f"{s:10.3f}s  busy ({eng})")
+            extra = ""
+            if eng in chunk and chunk[eng] > 0:
+                extra = (f"   of {chunk[eng]:.3f}s chunk wall "
+                         f"({s / chunk[eng] * 100:.1f}%)")
+            lines.append(f"{s:10.3f}s  busy ({eng}){extra}")
         if summary.get("duty_cycle") is not None:
             lines.append(f"{summary['duty_cycle'] * 100:9.1f}%  "
                          "duty cycle (busy / wall; >100% = "
@@ -199,6 +214,43 @@ def _introspection_lines(metrics_like, wall_s=None):
         elif wall_s is None:
             lines.append("(no trace wall to compute the duty cycle "
                          "against)")
+    phase_s = summary.get("phase_s") or {}
+    if phase_s:
+        lines.append("\n-- where the time goes (per-dispatch "
+                     "phases) --")
+        for eng, per in phase_s.items():
+            total = sum(per.values()) or 1.0
+            lines.append(f"{eng}:")
+            for p, s in sorted(per.items(), key=lambda kv: -kv[1]):
+                lines.append(f"{s:10.3f}s  {p:<8} "
+                             f"({s / total * 100:5.1f}%)")
+    return lines
+
+
+def _bubble_lines(ledger):
+    """The idle-bubble section from a bubble ledger dict
+    (obs.bubbles); [] when the trace carried no phase spans."""
+    if not ledger or not ledger.get("episodes"):
+        return []
+    lines = ["\n-- idle bubbles (makespan minus device-compute) --"]
+    lines.append(f"{ledger['device_s']:10.3f}s  device-compute "
+                 f"({ledger['lanes']} lane(s), "
+                 f"{ledger['episodes']} episode(s))")
+    lines.append(f"{ledger['idle_s']:10.3f}s  idle, "
+                 f"{ledger['attribution_frac'] * 100:.1f}% attributed")
+    idle = ledger.get("idle_s") or 0.0
+    for p, s in sorted((ledger.get("phases") or {}).items(),
+                       key=lambda kv: -kv[1]):
+        if p == "device" or s <= 0:
+            continue
+        pct = f" ({s / idle * 100:5.1f}% of idle)" if idle else ""
+        lines.append(f"{s:10.3f}s  {p:<8}{pct}")
+    if ledger.get("residual_s"):
+        lines.append(f"{ledger['residual_s']:10.3f}s  (unattributed "
+                     "residual)")
+    if ledger.get("inter_episode_s"):
+        lines.append(f"{ledger['inter_episode_s']:10.3f}s  between "
+                     "episodes (outside the dispatch pipeline)")
     return lines
 
 
@@ -392,6 +444,25 @@ def summarize_campaign(campaign_dir):
             fold = None
     if fold is not None:
         lines += _introspection_lines(fold, makespan_s)
+
+    # -- idle-bubble ledger: where the non-device time went -------------
+    # (bubble_ledger.json is the fold run_fleet writes at finalize;
+    # fold the merged trace in process when it is missing)
+    ledger = None
+    try:
+        with open(os.path.join(campaign_dir,
+                               "bubble_ledger.json")) as f:
+            ledger = json.load(f)
+    except (OSError, ValueError):
+        pass
+    if ledger is None and events:
+        try:
+            from jepsen_tpu.obs.bubbles import fold_events
+            ledger = fold_events(events)
+        except Exception:  # noqa: BLE001 - the summary must print
+            ledger = None
+    if ledger is not None:
+        lines += _bubble_lines(ledger)
 
     # -- capacity plan: predicted vs actual compile shapes --------------
     lines += _capacity_lines(campaign_dir, report)

@@ -35,6 +35,20 @@ and two renderers (`render_text` / `to_json`):
   delegates to the live ``jax_wgl._plan_sizes`` /
   ``compile_cache.bucket_for`` so jaxlint and capplan cannot drift
   from the engines.
+* **certify** -- proof-carrying verdicts: post-hoc static
+  certification of every device search from its own artifacts
+  (VC001-VC012). Valid verdicts replay their normalized witness
+  through the pure CPU model step function (transition legality,
+  real-time precedence, per-segment re-certification); invalid
+  verdicts cross-check the failing segment through an independent
+  CPU engine under a budget; a sampled differential harness replays
+  encoded segments through jax-wgl vs ``linear`` vs ``wgl``. Runs
+  per test in ``checker.core.certify_verdict`` (opt out
+  ``test["certify?"] = False``), as the monitor's ``skip-offline?``
+  backstop, on ``/api/check`` (``"certify": true``), sampled at
+  campaign finalize (``report.json["certification"]``), and offline
+  via ``tools/lint.py --certify``. Certificates persist
+  byte-deterministically as ``certificate.json``.
 * **codelint** -- AST thread-safety lint over the framework's own
   source, driven by ``tools/lint.py``.
 * **fleetlint** -- the control plane's own Jepsen: a post-hoc audit
@@ -49,8 +63,9 @@ and two renderers (`render_text` / `to_json`):
 See doc/analysis.md for the code catalogue.
 """
 
-from . import (capplan, codelint, fleetlint, fleetmodel,  # noqa: F401
-               histlint, jaxlint, planlint, searchplan, sizemodel)
+from . import (capplan, certify, codelint, fleetlint,  # noqa: F401
+               fleetmodel, histlint, jaxlint, planlint, searchplan,
+               sizemodel)
 from .diagnostics import (Diagnostic, ERROR, INFO,  # noqa: F401
                           SEVERITIES, WARNING, diag, errors,
                           max_severity, render_text, run_analyzer,
@@ -64,7 +79,7 @@ __all__ = [
     "errors", "warnings", "max_severity", "severity_counts",
     "render_text", "to_json", "run_analyzer",
     "histlint", "planlint", "jaxlint", "codelint", "searchplan",
-    "fleetlint", "fleetmodel", "capplan", "sizemodel",
+    "fleetlint", "fleetmodel", "capplan", "sizemodel", "certify",
     "lint_history", "lint_encoded", "lint_test_history",
     "lint_plan", "preflight", "PlanLintError",
 ]

@@ -113,6 +113,14 @@ Codes:
                  trend baseline recorded under a different
                  environment fingerprint than this host (the gate
                  would refuse to compare at run time) -- warning
+  PL023 mixed    verdict certification (analysis/certify.py): a
+                 non-positive / non-integer certify sample count or
+                 cross-check budget -- errors; certify knobs set
+                 while certification is opted out (ignored) --
+                 warning; certification active alongside a
+                 ``skip-offline?`` monitor -- info noting the
+                 certifier is the ONLY independent check of the
+                 monitor's verdict of record on that path
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -125,14 +133,15 @@ from __future__ import annotations
 
 import logging
 
-from .diagnostics import ERROR, WARNING, diag, errors, render_text
+from .diagnostics import ERROR, INFO, WARNING, diag, errors, render_text
 from .histlint import model_op_set
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["lint_plan", "lint_campaign", "lint_fleet", "lint_service",
            "lint_telemetry", "lint_fleetlint", "lint_introspection",
-           "lint_coalesce", "lint_capacity", "lint_trend", "preflight",
+           "lint_coalesce", "lint_capacity", "lint_trend",
+           "lint_certify", "preflight",
            "PlanLintError", "FATAL_CODES", "FLEETLINT_MODES",
            "monitor_diags", "searchplan_diags"]
 
@@ -308,6 +317,9 @@ def lint_plan(test):
 
     # -- phase-attribution / trend-gate knobs (obs.phases / obs.trend) -
     diags += lint_trend(test)
+
+    # -- verdict-certification knobs (analysis/certify.py) -------------
+    diags += lint_certify(test)
     return diags
 
 
@@ -546,6 +558,72 @@ def searchplan_diags(test):
                 "drop 'skip-offline?' (keep the offline re-check) or "
                 "set {'quiescent-carry?': False} alongside it"))
     return diags
+
+
+def lint_certify(test):
+    """The PL023 rules over a test map's (or option map's) verdict
+    certification knobs (analysis/certify.py)."""
+    diags = []
+    raw = test.get("certify")
+    opted_out = test.get("certify?") is False
+    if isinstance(raw, dict):
+        if opted_out:
+            diags.append(diag(
+                "PL023", WARNING,
+                "certify knobs are set but certification is opted "
+                "out (certify? False): the knobs are ignored",
+                "plan.certify",
+                "drop test['certify?'] = False or the knob block"))
+        samples = raw.get("samples")
+        if samples is not None and (not isinstance(samples, int)
+                                    or isinstance(samples, bool)
+                                    or samples <= 0):
+            diags.append(diag(
+                "PL023", ERROR,
+                "certify differential sample count must be a "
+                f"positive integer, got {samples!r}",
+                "plan.certify.samples",
+                "how many encoded segments the differential harness "
+                f"replays per run (default "
+                f"{_certify_default('DEFAULT_SAMPLES')}); omit the "
+                "key for the default, or set certify? False to skip "
+                "certification entirely"))
+        budget = raw.get("budget")
+        if budget is not None and (not isinstance(budget, int)
+                                   or isinstance(budget, bool)
+                                   or budget <= 0):
+            diags.append(diag(
+                "PL023", ERROR,
+                "certify cross-check budget must be a positive "
+                f"integer (configs), got {budget!r}",
+                "plan.certify.budget",
+                "the bounded CPU re-decision of a failing segment "
+                "explores at most this many configurations (default "
+                f"{_certify_default('DEFAULT_BUDGET')})"))
+    elif raw is not None:
+        diags.append(diag(
+            "PL023", ERROR,
+            f"certify knobs must be a mapping, got {raw!r}",
+            "plan.certify"))
+    if not opted_out:
+        mon = test.get("monitor")
+        cfg = mon if isinstance(mon, dict) else {}
+        if cfg.get("skip-offline?"):
+            diags.append(diag(
+                "PL023", INFO,
+                "skip-offline? hands the monitor's verdict over as "
+                "final: verdict certification is the ONLY independent "
+                "check of that verdict on this path (the violation "
+                "evidence is cross-checked through a second engine at "
+                "analyze time)", "plan.monitor",
+                "keep certification on (the default) when combining "
+                "skip-offline? with the monitor"))
+    return diags
+
+
+def _certify_default(name):
+    from . import certify as _c
+    return getattr(_c, name)
 
 
 def monitor_diags(test):

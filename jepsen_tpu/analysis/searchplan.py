@@ -668,11 +668,18 @@ def merge_segment_results(results, info=None, plan_s=0.0,
                               "elided": info.get("elided", 0)}
                              if info else {}),
                           "plan_s": round(plan_s, 6)}}
+    # carry every segment's normalized witness (checker/witness.py),
+    # segment provenance included: the verdict certifier
+    # (analysis/certify.py) re-certifies each segment against a
+    # replanned cut, seed pairs honored
+    wits = [r.get("witness") for r in results]
+    if any(isinstance(w, dict) for w in wits):
+        out["witnesses"] = wits
     if valid is False:
         for i, r in enumerate(results):
             if r.get("valid") is False:
                 for k in ("op", "final_paths", "previous_ok", "configs",
-                          "pattern", "error"):
+                          "pattern", "error", "witness"):
                     if k in r:
                         out[k] = r[k]
                 out["searchplan"]["failed_segment"] = i

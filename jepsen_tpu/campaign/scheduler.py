@@ -126,7 +126,8 @@ def _outcome_of(test, latch):
 
 def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
               resume=False, latch=None, run_fn=None, ledger=True,
-              backends=None, fleetlint=True, capacity_plan=None):
+              backends=None, fleetlint=True, capacity_plan=None,
+              certify=True):
     """Run a campaign; returns the aggregated report dict (also
     persisted as report.json in the campaign directory).
 
@@ -153,7 +154,13 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
     (``compile_cache.noted_keys`` bracket) into
     ``report["capacity"]`` -- the prediction oracle. CONTAINED both
     ends: a crashing planner/oracle never changes a cell outcome or
-    the campaign exit code (the searchplan rule)."""
+    the campaign exit code (the searchplan rule).
+
+    ``certify=True`` (default) re-certifies a deterministic sample of
+    the cells' persisted runs at finalize from their own artifacts
+    (analysis.certify: witness replay + certificate/results
+    agreement) into ``report["certification"]``. CONTAINED the same
+    way: sampled findings are reported, never outcome-bearing."""
     cells = list(cells)
     ids = [c["id"] for c in cells]
     if len(set(ids)) != len(ids):
@@ -462,6 +469,20 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
                 logger.warning("fleetlint audit of campaign %s "
                                "crashed (contained)", campaign_id,
                                exc_info=True)
+        if certify:
+            try:
+                # proof-carrying verdicts, campaign grain: replay a
+                # deterministic sample of the cells' persisted
+                # certificates against their own run artifacts.
+                # CONTAINED -- findings are reported, never allowed
+                # to change an outcome or the exit code
+                from ..analysis import certify as jcertify
+                report["certification"] = \
+                    jcertify.certify_campaign(jr.latest())
+                jr.write_report(report)
+            except Exception:  # noqa: BLE001 - certifier is contained
+                logger.warning("campaign certification crashed "
+                               "(contained)", exc_info=True)
         if hard_abort is not None:
             raise hard_abort
         return report

@@ -238,6 +238,14 @@ class Linearizable(Checker):
             eopts = {k: v for k, v in self.engine_opts.items()
                      if k in self._PLANNED_OPTS}
             results = check_batch_encoded(self.spec, pairs, **eopts)
+            # stamp segment provenance onto each normalized witness
+            # before the merge folds them: the certifier re-derives
+            # the same cuts and matches index/count/seed exactly
+            for i, (r, s) in enumerate(zip(results, segs)):
+                w = r.get("witness")
+                if isinstance(w, dict):
+                    w["segment"] = {"index": i, "count": len(segs),
+                                    "seed": s.seed}
             merged = searchplan.merge_segment_results(results, info,
                                                       plan_s)
             if obs.enabled():

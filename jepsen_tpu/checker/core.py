@@ -17,7 +17,7 @@ from ..util import real_pmap
 
 __all__ = ["Checker", "check", "check_safe", "compose", "concurrency_limit",
            "noop", "unbridled_optimism", "merge_valid", "valid_prio",
-           "lint_history", "plan_history"]
+           "lint_history", "plan_history", "certify_verdict"]
 
 logger = logging.getLogger(__name__)
 
@@ -156,11 +156,79 @@ def plan_history(test, hist):
         logger.warning("search planning crashed", exc_info=True)
 
 
+def certify_verdict(checker, test, hist, result, key=None):
+    """Certify a decided Linearizable verdict from its own artifacts,
+    after the checker returns: replay the witness through the pure CPU
+    model (VC001-VC003), cross-check invalid verdicts through an
+    independent engine (VC008), and run the sampled differential
+    harness (VC010). Findings land in ``test["analysis"]["certify"]``
+    and the full proof in ``test["certificate"]`` (persisted as
+    certificate.json); error findings are logged. Opt out per test
+    with ``test["certify?"] = False``. Runs at most once per test
+    dict — Compose fans every subchecker back through check(), and
+    only the Linearizable call carries a certifiable result.
+
+    Certification is contained exactly like histlint/searchplan: a
+    certifier bug must NEVER flip a verdict or exit code."""
+    if not isinstance(result, dict) \
+            or result.get("valid") not in (True, False):
+        return
+    try:
+        from ..analysis import certify
+        if not certify.enabled(test):
+            return
+        from .checkers import Linearizable
+        if not isinstance(checker, Linearizable):
+            return
+        with _lint_lock:
+            if test.get("certify-done?"):
+                return
+            test["certify-done?"] = True
+        from .. import analysis
+        cfg = certify.config(test)
+        client = checker.prepare_history(h.client_ops(hist))
+        holder = {}
+
+        def build():
+            cert, diags = certify.certify_with_diagnostics(
+                checker.spec, client, result, test=test,
+                samples=cfg["samples"], budget=cfg["budget"],
+                init_ops=checker.init_ops, key=key)
+            holder["cert"] = cert
+            return diags
+
+        diags = analysis.run_analyzer("certify", build)
+        cert = holder.get("cert")
+        if cert is not None:
+            report = analysis.to_json(diags)
+            report["summary"] = {"verdict": cert["verdict"],
+                                 "engine": cert["engine"],
+                                 "checks": cert["checks"]}
+            test.setdefault("analysis", {})["certify"] = report
+            test["certificate"] = cert
+        errs = analysis.errors(diags)
+        if obs.enabled():
+            obs.inc("analysis.certify.runs",
+                    verdict=str(result.get("valid")))
+            if errs:
+                obs.inc("analysis.certify.vc_errors", len(errs))
+        if errs:
+            logger.warning(
+                "%s", analysis.render_text(
+                    errs, title="verdict certification FAILED; the "
+                                "verdict above does not replay from "
+                                "its own witness:"))
+    except Exception:  # noqa: BLE001 - contained, never verdict-bearing
+        logger.warning("verdict certification crashed", exc_info=True)
+
+
 def check(checker, test, hist, opts=None):
     hist = h.ensure_indexed(hist)
     lint_history(test, hist)
     plan_history(test, hist)
-    return as_checker(checker).check(test, hist, opts or {})
+    result = as_checker(checker).check(test, hist, opts or {})
+    certify_verdict(checker, test, hist, result)
+    return result
 
 
 def check_safe(checker, test, hist, opts=None):

@@ -1422,6 +1422,7 @@ def _interpret(spec, e, out, max_iters, confirm, init_state, perm=None):
               "engine": "jax-wgl"}
     if status == VALID:
         result["valid"] = True
+        _attach_valid_witness(result, e, out, perm, spec, init_state)
         return result
     exhausted = int(out["top"]) == 0
     dropped = bool(out["dropped"])
@@ -1440,13 +1441,12 @@ def _interpret(spec, e, out, max_iters, confirm, init_state, perm=None):
     return result
 
 
-def _attach_witness(result, e, out, perm, spec, init_state):
-    """Decode the TOPK deepest distinct stuck configurations into
-    knossos-style witness fields (op / final_paths / previous_ok /
-    configs, see checker/witness.py; knossos returns a LIST of stuck
-    :configs, reference checker.clj:213-216). Bit positions are in
-    priority-sorted space; perm maps them back to original op
-    indices."""
+def _decode_slots(e, out, perm):
+    """Decode the TOPK witness slots into (linearized bool[n], state)
+    pairs, deepest-first. Bit positions are in priority-sorted space;
+    perm maps them back to original op indices. Shared by the invalid
+    path (stuck configurations) and the VALID path (the winning
+    configuration rides the same slots)."""
     depths = np.asarray(out["best_depth"], np.int32).reshape(-1)
     lins = np.asarray(out["best_lin"], np.uint32).reshape(len(depths), -1)
     states = np.asarray(out["best_state"],
@@ -1462,12 +1462,43 @@ def _attach_witness(result, e, out, perm, spec, init_state):
             pos = int(perm[i]) if perm is not None else i
             linearized[pos] = bool((lin[i // 32] >> np.uint32(i % 32)) & 1)
         slots.append((linearized, states[s]))
+    return slots
+
+
+def _attach_witness(result, e, out, perm, spec, init_state):
+    """Decode the TOPK deepest distinct stuck configurations into
+    knossos-style witness fields (op / final_paths / previous_ok /
+    configs, see checker/witness.py; knossos returns a LIST of stuck
+    :configs, reference checker.clj:213-216)."""
+    slots = _decode_slots(e, out, perm)
     if not slots:
         # no child ever linearized (the search wedged at the root):
         # the root config IS the stuck config
-        slots = [(np.zeros(n, bool), np.asarray(init_state, np.int32))]
+        slots = [(np.zeros(len(e), bool),
+                  np.asarray(init_state, np.int32))]
     from . import witness
     witness.attach_multi(result, spec, e, slots, init_state)
+
+
+def _attach_valid_witness(result, e, out, perm, spec, init_state):
+    """On VALID the winning configuration sits in the TOPK witness
+    slots too (both kernel success sites topk_insert the candidate
+    before raising the status), so a valid verdict's proof decodes
+    exactly like the invalid path's: the deepest slot covering every
+    ok op IS the linearization the search found. The normalized
+    witness (checker/witness.py ``build``) lands on
+    ``result["witness"]`` for the certifier to replay. Absence -- a
+    slot-layout drift -- leaves the witness off; the certifier
+    reports it (VC006), never a crash here."""
+    is_ok = np.asarray(e.is_ok, bool)
+    n_ok = int(is_ok.sum())
+    for linearized, _state in _decode_slots(e, out, perm):
+        if int((linearized & is_ok).sum()) == n_ok:
+            from . import witness
+            result["witness"] = witness.build(
+                spec, e, result.get("engine"), True, linearized,
+                init_state)
+            return
 
 
 def check_history(spec, history, **kw):

@@ -38,6 +38,12 @@ PATH_TAIL = 100
 #: but an adversarial set could force exponential work
 REPLAY_BUDGET = 500_000
 
+#: version tag of the NORMALIZED witness dict (``result["witness"]``)
+#: every engine emits -- the one schema the verdict certifier
+#: (analysis/certify.py) replays. Bump on any field change; the
+#: certifier rejects unknown schemas as malformed (VC005).
+WITNESS_SCHEMA = 1
+
 
 class _RetMin:
     """Segment tree over return indices: global min with O(log n)
@@ -184,6 +190,56 @@ def config_entry(spec, e, linearized, state, last_op=None):
             "pending": [_decode_op(e, int(i)) for i in pending[:16]]}
 
 
+def _witness_dict(spec, e, engine, valid, linearized, path,
+                  fallback_state):
+    """The normalized witness dict (schema ``WITNESS_SCHEMA``) built
+    from an already-computed replay ``path`` (or None when the replay
+    budget ran out). This is the ONE shape all engines emit -- the
+    device single-key search, the keyshard batch, the mesh-sharded
+    search, and the CPU engines -- so one certifier reads all of
+    them. Fields:
+
+      schema: WITNESS_SCHEMA
+      engine: the producing engine's name (None when the caller sets
+        none, e.g. the bare CPU oracle before competition labels it)
+      verdict: the verdict this witness supports -- True: ``order`` is
+        a claimed legal linearization covering every ok op; False: the
+        deepest stuck configuration the search reached
+      rows / n_ok: the encoded-history shape the row indices refer to
+      linearized_rows: sorted encoded-row indices in the configuration
+      order: those rows as a legal WGL step sequence, or None when the
+        replay budget ran out (the set is then unordered)
+      final_state: decoded model state after the last ordered step
+      segment: searchplan provenance {"index", "count", "seed"} filled
+        in by the planned batch path, else None
+    """
+    linearized = np.asarray(linearized, bool)
+    state = path[-1][1] if path else fallback_state
+    return {"schema": WITNESS_SCHEMA,
+            "engine": engine,
+            "verdict": bool(valid),
+            "rows": int(len(e)),
+            "n_ok": int(e.n_ok),
+            "linearized_rows": [int(i)
+                                for i in np.flatnonzero(linearized)],
+            "order": ([int(i) for i, _ in path]
+                      if path is not None else None),
+            "final_state": _decode_state(spec, state),
+            "segment": None}
+
+
+def build(spec, e, engine, valid, linearized, init_state,
+          budget=REPLAY_BUDGET):
+    """Build a normalized witness for ``linearized`` from scratch:
+    replay the set into a legal order (final_path) and shape the
+    schema-``WITNESS_SCHEMA`` dict. Used by the engines' VALID paths,
+    where no knossos-style attach ran to compute the path already."""
+    linearized = np.asarray(linearized, bool)
+    path = final_path(spec, e, linearized, init_state, budget=budget)
+    return _witness_dict(spec, e, engine, valid, linearized, path,
+                         np.asarray(init_state, np.int32))
+
+
 def attach(result, spec, e, linearized, best_state, init_state):
     """Shape knossos-style witness fields onto ``result`` (mutates and
     returns it). ``linearized``: bool[n] of the deepest configuration."""
@@ -196,6 +252,12 @@ def attach(result, spec, e, linearized, best_state, init_state):
     result["linearized_ok_ops"] = int((linearized & is_ok).sum())
 
     path = final_path(spec, e, linearized, init_state)
+    # the machine-checkable counterpart of the knossos fields below:
+    # one normalized dict the certifier replays, same path, no extra
+    # search work
+    result["witness"] = _witness_dict(
+        spec, e, result.get("engine"), result.get("valid", False),
+        linearized, path, np.asarray(best_state, np.int32))
     if path is not None:
         tail = path[-PATH_TAIL:]
         steps = [{"op": _decode_op(e, i),

@@ -804,6 +804,10 @@ def campaign_cmd(opts):
         # device-introspection knob preflight (PL019) rides the same
         # way: profile / progress-cadence mistakes surface at --lint
         diags += analysis.planlint.lint_introspection(options)
+        # verdict-certification knob preflight (PL023) rides the same
+        # way: bad sample counts / cross-check budgets surface at
+        # --lint, and the skip-offline? backstop note lands here too
+        diags += analysis.planlint.lint_certify(options)
         # fleetlint knob preflight (PL018, knob half) rides the same
         # way; the journal half runs inside run_fleet's resume path
         diags += analysis.planlint.lint_fleetlint(

@@ -290,6 +290,48 @@ def run_case(test):
             return interpreter.run(test)
 
 
+def _certify_monitor_verdict(test, mv):
+    """Certify a monitor violation from the evidence the monitor
+    parked at detection time (jepsen_tpu.analysis.certify): replay its
+    witness and cross-check the violating prefix through an
+    independent CPU engine. This is the backstop for the
+    ``skip-offline?`` handoff, where the monitor's False becomes the
+    verdict of record with no offline re-check behind it (planlint
+    PL023 notes the pairing). Contained: certification never flips a
+    verdict or exit code."""
+    ev = test.pop("monitor-evidence", None)
+    if ev is None or not (isinstance(mv, dict)
+                          and mv.get("verdict") is False):
+        return
+    try:
+        from .analysis import certify
+        if not certify.enabled(test):
+            return
+        budget = certify.config(test)["budget"]
+        holder = {}
+
+        def build():
+            summary, diags = certify.certify_monitor(ev, budget=budget)
+            holder["summary"] = summary
+            return diags
+
+        janalysis.run_analyzer("certify-monitor", build)
+        summary = holder.get("summary")
+        if summary is None:
+            return
+        test.setdefault("analysis", {})["certify-monitor"] = summary
+        if isinstance(test.get("results"), dict):
+            test["results"]["monitor-certification"] = {
+                "confirmed": summary.get("confirmed"),
+                "counts": summary.get("counts")}
+        if (summary.get("counts") or {}).get("error"):
+            logger.warning(
+                "monitor violation FAILED certification: %s",
+                summary["counts"])
+    except Exception:  # noqa: BLE001 - contained, never verdict-bearing
+        logger.warning("monitor certification crashed", exc_info=True)
+
+
 def analyze(test):
     """Index the history, run the checker, save results
     (core.clj:221-236). Salvaged runs (abort mid-run: the history is a
@@ -327,6 +369,7 @@ def analyze(test):
         # persist the monitor's verdict next to the offline one so the
         # two can be cross-checked from results.json alone
         test["results"]["monitor"] = mv
+    _certify_monitor_verdict(test, mv)
     logger.info("Analysis complete")
     if test.get("name"):
         store.save_2(test)

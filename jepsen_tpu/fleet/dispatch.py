@@ -271,6 +271,9 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     # PL019 (phases off while profile or a bubble fold needs their
     # spans, unreadable trend baselines, bad gate thresholds)
     diags += planlint.lint_trend(base_options)
+    # PL023: verdict-certification knobs ride along the same way (bad
+    # sample counts / budgets; the skip-offline? backstop note)
+    diags += planlint.lint_certify(base_options)
     # PL020: cross-tenant coalescing knobs ride along like the other
     # serve knobs (the CLI co-launches the service; bad windows and
     # no-op configurations surface before any host is contacted)

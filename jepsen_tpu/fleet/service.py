@@ -1013,6 +1013,51 @@ def check_history(payload, caller="local"):
     return out
 
 
+def _certify_one(spec, slot, payload):
+    """Certify one (sub)history's merged result ("_certify" stash) and
+    return the response summary. Differential sampling is off on the
+    service path (a replay per request would double device load); the
+    witness replay + invalid cross-check are the cheap, bounded
+    parts."""
+    from ..analysis import certify
+    stash = slot.pop("_certify", None)
+    if stash is None:
+        return {"certified": False}
+    merged, client = stash
+    cert, diags = certify.certify_with_diagnostics(
+        spec, client, merged, samples=0, differential=False,
+        init_ops=payload.get("init-ops"))
+    return {"certified": True, "verdict": cert["verdict"],
+            "counts": cert["counts"], "checks": cert["checks"],
+            "diagnostics": cert["diagnostics"]}
+
+
+def _certify_response(spec, out, payload):
+    """The "certify": true response block, over the single submission
+    or folded across a keyed submission's per-key results. Contained:
+    a certifier crash reports itself instead of failing the check."""
+    try:
+        if "keys" in out:
+            per_key = {k: _certify_one(spec, slot, payload)
+                       for k, slot in sorted(out["keys"].items())}
+            counts = {}
+            for s in per_key.values():
+                for sev, n in (s.get("counts") or {}).items():
+                    counts[sev] = counts.get(sev, 0) + n
+            return {"certified": True, "counts": counts,
+                    "keys": per_key}
+        return _certify_one(spec, out, payload)
+    except Exception:  # noqa: BLE001 - contained, never verdict-bearing
+        logger.warning("/api/check certification crashed",
+                       exc_info=True)
+        # the stashes hold ndarray-bearing results: never let one
+        # leak into the JSON response
+        for slot in [out] + list((out.get("keys") or {}).values()):
+            if isinstance(slot, dict):
+                slot.pop("_certify", None)
+        return {"certified": False, "error": "certification crashed"}
+
+
 def _check_admitted(payload, hist, caller="local"):
     from ..analysis import histlint, errors as diag_errors
     from ..checker.checkers import Linearizable
@@ -1039,6 +1084,15 @@ def _check_admitted(payload, hist, caller="local"):
     if not isinstance(payload.get("coalesce", True), bool):
         raise ApiError(400, f"'coalesce' must be a boolean, got "
                             f"{payload['coalesce']!r}")
+    if not isinstance(payload.get("certify", False), bool):
+        raise ApiError(400, f"'certify' must be a boolean, got "
+                            f"{payload['certify']!r}")
+    # proof-carrying verdicts on demand: "certify": true replays the
+    # verdict's witness through the pure CPU model and cross-checks
+    # invalid verdicts through an independent engine
+    # (analysis/certify.py); the summary rides back on the response.
+    # Contained: certification can never change the verdict
+    certify_on = bool(payload.get("certify", False))
     # cross-tenant coalescing: only the device engine batches (the CPU
     # engines have no key axis); the payload may opt a single request
     # out ("coalesce": false), e.g. to compare against the solo path
@@ -1094,12 +1148,14 @@ def _check_admitted(payload, hist, caller="local"):
         Returns the phase-2 closure that waits and folds."""
         client = lin.prepare_history(jhistory.client_ops(sub))
         segments = [client]
+        seg_seeds = [None]
         plan_meta = None
         n_ops = None
         if plan_on:
             segs, info = searchplan.plan_segments(spec, client)
             if len(segs) > 1:
                 segments = [s.events for s in segs]
+                seg_seeds = [s.seed for s in segs]
                 plan_meta = {"segments": len(segs),
                              "cuts": info["cuts"],
                              "elided": info["elided"]}
@@ -1137,6 +1193,15 @@ def _check_admitted(payload, hist, caller="local"):
                 r = coal.wait(item)
                 per_seg[slot] = r if r is not None \
                     else solo(e, init_state)
+            # stamp witness provenance exactly like the offline
+            # planned path (checkers._check_planned): the certifier
+            # re-certifies each segment against a replanned cut, and
+            # the (index, count, seed) triple is part of the proof
+            for i, r in enumerate(per_seg):
+                w = r.get("witness") if isinstance(r, dict) else None
+                if isinstance(w, dict):
+                    w["segment"] = {"index": i, "count": len(per_seg),
+                                    "seed": seg_seeds[i]}
             # demux back into one per-(sub)history verdict through
             # the same fold the planned offline paths use (worst-wins
             # validity, configs sum, failing segment's witness
@@ -1160,6 +1225,10 @@ def _check_admitted(payload, hist, caller="local"):
                           for r in per_seg), default=0)
             if use_coal and owners:
                 out["coalesced"] = {"owners": owners}
+            if certify_on:
+                # raw material for the post-verdict certification
+                # below (popped before the response is shaped)
+                out["_certify"] = (merged, client)
             return out
 
         return finish
@@ -1188,6 +1257,8 @@ def _check_admitted(payload, hist, caller="local"):
         logger.warning("/api/check failed", exc_info=True)
         raise ApiError(422, f"history could not be checked: "
                             f"{exc!r}") from None
+    if certify_on:
+        out["certify"] = _certify_response(spec, out, payload)
     out.update({"model": spec.name, "engine": engine,
                 "events": len(hist),
                 "wall_s": round(time.monotonic() - t0, 3),

@@ -140,6 +140,10 @@ class Monitor:
         self.quiescent_carry = bool(quiescent_carry)
         self.truncated_ops = 0
         self.violation = None
+        #: certifiable violation evidence (encoded prefix + engine
+        #: result), parked on the test map by finalize for the
+        #: certify backstop in core.analyze
+        self.evidence = None
         # sinks captured at construction through the RUN-SCOPED
         # resolution (install runs on the run's own thread inside
         # obs.run_scope): overlapping campaign cells must not
@@ -370,6 +374,14 @@ class Monitor:
             w = r.get("op")
             if isinstance(w, dict):
                 self.violation["detected_op"] = dict(w)
+            # park the certifiable evidence: the encoded prefix that
+            # decided False plus the engine result. core.analyze's
+            # certify backstop replays the witness and cross-checks
+            # the prefix through an INDEPENDENT engine — under
+            # ``skip-offline?`` this verdict becomes the verdict of
+            # record with no full offline check behind it
+            self.evidence = {"e": e, "init_state": init_state,
+                             "result": r, "key": key}
             self._inc("monitor.violations")
             if self._reg is not None:
                 self._reg.set_gauge("monitor.detection_latency_s",
@@ -501,6 +513,11 @@ def finalize(mon, test, finish=True):
         mon.stop(finish=finish)
         summary = mon.summary()
         test["monitor-verdict"] = summary
+        if mon.evidence is not None:
+            # non-serializable (ndarrays + spec): store.py strips it;
+            # core.analyze pops it for the certify backstop
+            test["monitor-evidence"] = dict(mon.evidence,
+                                            spec=mon.spec)
         sinks = test.get("op-sinks")
         if isinstance(sinks, list) and mon.offer in sinks:
             sinks.remove(mon.offer)

@@ -214,8 +214,22 @@ def check_encoded_sharded(spec, e, init_state, mesh,
         ph.lap("host")
         return result
 
+    def _merged_slots():
+        # every shard's TOPK witness slots as one slot group (the
+        # decoder sorts by depth), so witness decoding matches the
+        # single-device engine's exactly
+        return {"best_depth": np.asarray(got["best_depth"]).reshape(-1),
+                "best_lin": np.asarray(got["best_lin"])
+                .reshape(D * jax_wgl.TOPK, -1),
+                "best_state": np.asarray(got["best_state"])
+                .reshape(D * jax_wgl.TOPK, -1)}
+
     if (status == VALID).any():
         result["valid"] = True
+        # the winning shard's slot carries the full linearization: emit
+        # the same normalized witness as the single-device VALID path
+        jax_wgl._attach_valid_witness(result, e, _merged_slots(), perm,
+                                      spec, init_state)
         return _done(result)
     if timed_out and ((status == RUNNING) & (top > 0)).any():
         result.update(valid="unknown", error="timeout")
@@ -227,16 +241,7 @@ def check_encoded_sharded(spec, e, init_state, mesh,
     dropped = bool(np.asarray(got["dropped"]).any())
     if exhausted and not dropped:
         result["valid"] = False
-        # merge every shard's TOPK witness slots (deepest-first; the
-        # decoder sorts by depth)
-        merged = {"status": status,
-                  "best_depth": np.asarray(got["best_depth"])
-                  .reshape(-1),
-                  "best_lin": np.asarray(got["best_lin"])
-                  .reshape(D * jax_wgl.TOPK, -1),
-                  "best_state": np.asarray(got["best_state"])
-                  .reshape(D * jax_wgl.TOPK, -1)}
-        jax_wgl._attach_witness(result, e, merged, perm, spec,
+        jax_wgl._attach_witness(result, e, _merged_slots(), perm, spec,
                                 init_state)
         return _done(result)
     result.update(valid="unknown",

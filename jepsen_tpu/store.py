@@ -38,8 +38,8 @@ base_dir = "store"
 DEFAULT_NONSERIALIZABLE_KEYS = {
     "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
     "remote", "barrier", "sessions", "dummy-log", "obs",
-    "analysis-done?", "searchplan-done?", "abort",
-    "journal", "partial-history",
+    "analysis-done?", "searchplan-done?", "certify-done?", "abort",
+    "journal", "partial-history", "monitor-evidence", "certificate",
     "op-sinks", "monitor-device-sem",
 }
 
@@ -375,6 +375,16 @@ def write_analysis(test):
         _dump_json(report, make_path(test, "analysis.json"))
 
 
+def write_certificate(test):
+    """Writes certificate.json: the proof-carrying verdict the
+    certifier built (witness, checks, VC diagnostics, re-certification
+    context) -- see jepsen_tpu.analysis.certify. Byte-deterministic:
+    same run artifacts, same bytes. No file for uncertified runs."""
+    cert = test.get("certificate")
+    if cert:
+        _dump_json(cert, make_path(test, "certificate.json"))
+
+
 def save_1(test):
     """Phase 1: history + test map, right after the run and before analysis
     (store.clj:388-399). Returns test."""
@@ -400,6 +410,7 @@ def save_2(test):
     write_test(test)
     write_analysis(test)   # histlint findings exist only after analyze
     write_monitor(test)
+    write_certificate(test)  # certify findings too (checker hook)
     update_symlinks(test)
     return test
 

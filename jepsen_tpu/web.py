@@ -99,8 +99,12 @@ def _fast_tests():
             # profile.json is the XLA profiler capture's marker
             # (obs/profile.py), written next to trace.jsonl when a
             # run was profiled — linked like the other artifacts
+            # certificate.json is the proof-carrying verdict
+            # (analysis/certify.py): the witness replayed + checks
+            # run, re-certifiable offline with tools/lint.py --certify
             obs_files = [f for f in ("metrics.json", "analysis.json",
-                                     "monitor.json", "profile.json")
+                                     "monitor.json", "profile.json",
+                                     "certificate.json")
                          if os.path.exists(store.path(fake, f))]
             mon = _monitor_header(store.path(fake, "monitor.json")) \
                 if "monitor.json" in obs_files else None

@@ -10,6 +10,7 @@ Usage::
     python tools/lint.py --no-ruff       # codelint only
     python tools/lint.py --campaign [ID] # fleetlint a stored campaign
     python tools/lint.py --matrix FILE  # capplan a campaign matrix
+    python tools/lint.py --certify [RUN] # re-certify a stored run
 
 Exit codes: 0 clean (warnings allowed), 1 error-severity codelint
 diagnostics or ruff violations, 2 internal error. ruff is optional at
@@ -28,6 +29,14 @@ prints the capacity table -- per-cell compile shapes, HBM footprints,
 int32-wall proximity -- plus the CP001-CP008 diagnostics, and exits 1
 on CP errors. ``--device-mem-budget BYTES`` enables the HBM half.
 Nothing runs, nothing is written.
+
+``--certify [RUN]`` re-certifies a stored run directory (default:
+``store/latest``) purely from its persisted artifacts: the
+certificate.json witness is replayed through the pure CPU model
+against the re-encoded history.jsonl and cross-checked against
+results.json (analysis.certify, VC001-VC012). Exits 1 on VC errors
+-- a tampered witness, a flipped verdict, or a certificate that
+disagrees with the results it rode along with. 2 = no such run.
 """
 
 from __future__ import annotations
@@ -101,6 +110,38 @@ def run_campaign_audit(campaign_id, as_json=False):
     return 1 if analysis.errors(diags) else 0
 
 
+def run_certify(run, budget=None, as_json=False):
+    """Re-certify a stored run directory from its persisted artifacts;
+    returns the exit code (0 clean / info, 1 VC errors, 2 no run)."""
+    from jepsen_tpu import store
+    from jepsen_tpu.analysis import certify
+    path = run
+    if path in (None, "", "latest"):
+        path = os.path.join(store.base_dir, "latest")
+    path = os.path.realpath(path)
+    if not os.path.isdir(path):
+        print(f"no run directory at {path!r}", file=sys.stderr)
+        return 2
+    summary, diags = certify.certify_run(path, budget=budget)
+    if summary is None and not diags:
+        print(f"{path}: no results.json to certify against",
+              file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(summary if summary is not None
+                         else analysis.to_json(diags),
+                         indent=1, sort_keys=True))
+    else:
+        print(analysis.render_text(diags, title=f"certify: {path}"))
+        if summary is not None and summary.get("certified"):
+            print(f"verdict {summary.get('verdict')!r} "
+                  f"(engine {summary.get('engine')}), "
+                  f"{len(summary.get('checks') or [])} check(s)")
+        elif summary is not None:
+            print("no certificate.json: nothing replayed")
+    return 1 if analysis.errors(diags) else 0
+
+
 def run_matrix_plan(path, device_mem_budget=None, as_json=False):
     """capplan a campaign matrix file; returns the exit code (0 clean
     / warnings, 1 CP errors, 2 unreadable matrix)."""
@@ -143,6 +184,16 @@ def main(argv=None):
                          "artifacts with fleetlint instead of linting "
                          "source (default ID: the latest campaign); "
                          "exit 1 on FL errors")
+    ap.add_argument("--certify", nargs="?", const="latest",
+                    default=None, metavar="RUN",
+                    help="re-certify a stored run directory's verdict "
+                         "from its certificate.json + history.jsonl "
+                         "(default RUN: store/latest); exit 1 on VC "
+                         "errors")
+    ap.add_argument("--budget", default=None, type=int,
+                    help="cross-check config budget for --certify "
+                         "(default: the certificate's recorded "
+                         "budget)")
     ap.add_argument("--matrix", default=None, metavar="FILE",
                     help="dry-run the capacity planner (capplan) over "
                          "a campaign matrix JSON: print the capacity "
@@ -155,6 +206,9 @@ def main(argv=None):
 
     if opts.campaign is not None:
         return run_campaign_audit(opts.campaign, as_json=opts.json)
+    if opts.certify is not None:
+        return run_certify(opts.certify, budget=opts.budget,
+                           as_json=opts.json)
     if opts.matrix is not None:
         budget = None
         if opts.device_mem_budget is not None:

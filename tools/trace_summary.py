@@ -177,9 +177,42 @@ def summarize(store_dir):
             f"{args.get('detected_at_index')} "
             f"(detection latency {args.get('detection_latency_s')}s)")
 
+    # -- proof-carrying verdict (analysis/certify.py) -------------------
+    lines += _certificate_lines(store_dir)
+
     if len(lines) == 1:
         lines.append("(no trace.jsonl / metrics.json found)")
     return "\n".join(lines)
+
+
+def _certificate_lines(store_dir):
+    """The run's certificate.json at a glance: the verdict it
+    certifies, the checks that ran (witness replay, segment
+    re-certification, cross-check, differential), and any VC
+    findings; [] for uncertified runs."""
+    try:
+        with open(os.path.join(store_dir, "certificate.json")) as f:
+            cert = json.load(f)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(cert, dict):
+        return []
+    lines = ["\n-- verdict certificate --"]
+    counts = cert.get("counts") or {}
+    verdict = "clean" if not counts.get("error") else "FAILED"
+    lines.append(f"{verdict}: verdict {cert.get('verdict')!r} "
+                 f"(engine {cert.get('engine')}), "
+                 f"{cert.get('rows', '?')} row(s); "
+                 f"{counts.get('error', 0)} error(s), "
+                 f"{counts.get('info', 0)} info")
+    for c in (cert.get("checks") or [])[:8]:
+        detail = {k: v for k, v in c.items() if k != "name"}
+        lines.append(f"  {c.get('name')}: {detail}")
+    for d in (cert.get("diagnostics") or [])[:8]:
+        loc = f" {d.get('location')}" if d.get("location") else ""
+        lines.append(f"  {str(d.get('severity', '?')).upper()} "
+                     f"{d.get('code')}{loc}: {d.get('message')}")
+    return lines
 
 
 def _introspection_lines(metrics_like, wall_s=None):
@@ -487,6 +520,24 @@ def summarize_campaign(campaign_dir):
             loc = f" {d.get('location')}" if d.get("location") else ""
             lines.append(f"  {str(d.get('severity', '?')).upper()} "
                          f"{d.get('code')}{loc}: {d.get('message')}")
+
+    # -- sampled verdict certification (analysis/certify.py) ------------
+    certn = (report or {}).get("certification")
+    if certn:
+        c = certn.get("counts") or {}
+        verdict = "clean" if not c.get("error") else "FAILED"
+        lines.append("\n-- verdict certification (sampled) --")
+        lines.append(
+            f"{verdict}: {certn.get('sampled', 0)}/{certn.get('of', 0)}"
+            f" run(s) re-certified; {c.get('error', 0)} error(s), "
+            f"{c.get('info', 0)} info"
+            + (f"; codes {certn.get('codes')}" if certn.get("codes")
+               else ""))
+        for r in (certn.get("runs") or [])[:8]:
+            rc = r.get("counts") or {}
+            state = "ok" if not rc.get("error") else \
+                f"FAILED {r.get('codes')}"
+            lines.append(f"  {r.get('path')}: {state}")
 
     return "\n".join(lines)
 

@@ -362,6 +362,127 @@ def _fleet_survival_rung(time_limit_s=2, budget_s=900):
         return {"error": repr(exc)[:300]}
 
 
+def _ha_takeover_rung(time_limit_s=2, budget_s=900):
+    """Coordinator failover (jepsen_tpu.fleet.ha): the rung-10 2-seed
+    register matrix on 2 loopback workers, two ways:
+
+      clean        coordinator HA on (lease 3 s), no faults: the
+                   lease-renewal plane's price on the fleet wall
+      kill         the ``coordinator-kill`` chaos fault SIGKILLs the
+                   active coordinator right after a seeded lease
+                   grant; a standby process tails the journal, fences
+                   the corpse, and finishes the campaign
+
+    Reported: detection+takeover latency (SIGKILL, stamped by the
+    chaos die-once marker, to the standby's first post-takeover
+    coordinator-lease grant), cells re-leased vs lost after the kill,
+    and the kill-soak wall vs the clean HA wall. Self-contained and
+    never fatal: a failover regression must show up as numbers (or an
+    error field), not break the bench."""
+    import os
+    import subprocess
+    import tempfile
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        workdir = tempfile.mkdtemp(prefix="jepsen-ha-takeover-")
+        env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
+        out = {"matrix": "workload=register x seeds=2",
+               "time_limit_s": time_limit_s, "coordinator_lease_s": 3}
+        base = [sys.executable, "-m", "jepsen_tpu", "campaign",
+                "--no-ssh", "--time-limit", str(time_limit_s),
+                "--axis", "workload=register", "--seeds", "2",
+                "--parallel", "2", "--workers", "local,local",
+                "--lease", "300", "--max-leases", "5",
+                "--coordinator-lease-s", "3", "--takeover-grace-s", "2"]
+
+        def read_journal(cid):
+            recs = []
+            path = os.path.join(workdir, "store", "campaigns", cid,
+                                "cells.jsonl")
+            with open(path) as f:
+                for ln in f:
+                    try:
+                        recs.append(json.loads(ln))
+                    except ValueError:
+                        pass
+            return recs
+
+        # clean: HA on, nobody dies -- the renewal plane's price
+        t0 = time.monotonic()
+        p = subprocess.run(base + ["--campaign-id", "ha-clean"],
+                           cwd=workdir, capture_output=True, text=True,
+                           timeout=budget_s, env=env)
+        clean_wall = round(time.monotonic() - t0, 1)
+        recs = read_journal("ha-clean")
+        out["clean"] = {
+            "wall_s": clean_wall, "exit": p.returncode,
+            "ok": sum(1 for r in recs if not r.get("event")
+                      and r.get("outcome") is True),
+            "renewals": sum(1 for r in recs
+                            if r.get("event") == "coordinator-lease"),
+        }
+
+        # kill: chaos SIGKILLs the coordinator; a standby takes over
+        t0 = time.monotonic()
+        coord = subprocess.Popen(
+            base + ["--chaos-profile", "coordinator-kill:7",
+                    "--campaign-id", "ha-kill"],
+            cwd=workdir, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, env=env)
+        cdir = os.path.join(workdir, "store", "campaigns", "ha-kill")
+        deadline = time.monotonic() + 60
+        while not os.path.exists(os.path.join(cdir, "campaign.json")) \
+                and time.monotonic() < deadline:
+            time.sleep(0.2)
+        standby = subprocess.run(
+            base + ["--standby", "--campaign-id", "ha-kill"],
+            cwd=workdir, capture_output=True, text=True,
+            timeout=budget_s, env=env)
+        coord.wait(timeout=budget_s)
+        kill_wall = round(time.monotonic() - t0, 1)
+
+        from jepsen_tpu.analysis.fleetmodel import parse_t
+        recs = read_journal("ha-kill")
+        takeover_i, takeover = next(
+            ((i, r) for i, r in enumerate(recs)
+             if r.get("event") == "coordinator-takeover"), (None, None))
+        # the chaos die-once marker is written (flush+fsync)
+        # immediately before the SIGKILL: its mtime IS the kill stamp
+        marker = os.path.join(cdir, "chaos-coordinator-kill")
+        kill_t = os.path.getmtime(marker) if os.path.exists(marker) \
+            else None
+        first_grant_t = next(
+            (parse_t(r.get("t")) for r in recs[takeover_i or 0:]
+             if r.get("event") == "coordinator-lease"
+             and r.get("epoch") == (takeover or {}).get("epoch")), None)
+        outcomes = [r for r in recs if not r.get("event")]
+        terminal = {str(r.get("cell")) for r in outcomes
+                    if r.get("outcome") != "aborted"}
+        releases = sum(1 for i, r in enumerate(recs)
+                       if r.get("event") == "lease"
+                       and takeover_i is not None and i > takeover_i)
+        out["kill"] = {
+            "wall_s": kill_wall,
+            "coordinator_exit": coord.returncode,   # -9: chaos landed
+            "standby_exit": standby.returncode,
+            "takeover": takeover is not None,
+            "takeover_epoch": (takeover or {}).get("epoch"),
+            "detect_takeover_s": round(
+                parse_t(takeover.get("t")) - kill_t, 1)
+            if takeover is not None and kill_t
+            and parse_t(takeover.get("t")) else None,
+            "kill_to_first_grant_s": round(first_grant_t - kill_t, 1)
+            if first_grant_t and kill_t else None,
+            "cells_releases_after_takeover": releases,
+            "cells_lost": 2 - len(terminal),
+            "kill_vs_clean_x": round(kill_wall / clean_wall, 2)
+            if clean_wall else None,
+        }
+        return out
+    except Exception as exc:  # noqa: BLE001 - numbers, not crashes
+        return {"error": repr(exc)[:300]}
+
+
 def _searchplan_rung(keys=4, bursts=6):
     """Search-plan reduction (jepsen_tpu.analysis.searchplan): the
     same quiescent multi-key cas-register batch checked with planning
@@ -1364,6 +1485,12 @@ def _bench_body(_obs_reg):
     # strictly beats OFF on checks/s at concurrency >= 8 with
     # per-submission verdicts identical to the solo path
     rungs["13-service-throughput"] = _service_throughput_rung()
+
+    # ha-takeover rung: kill the fleet coordinator mid-campaign and
+    # measure how fast a standby fences it and finishes the work —
+    # detection+takeover latency, re-leased vs lost cells, and the
+    # kill-soak wall against the clean HA wall (rung 10's matrix)
+    rungs["14-ha-takeover"] = _ha_takeover_rung()
 
     # CPU oracles race in parallel subprocesses AFTER all device
     # measurements (their CPU load would pollute the device numbers);

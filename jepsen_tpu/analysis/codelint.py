@@ -33,8 +33,9 @@ Codes:
          invariant (the fleetlint FL004 oracle) enforced at the
          source level: only the designated coordinator modules
          (``campaign/journal.py`` itself, ``campaign/scheduler.py``,
-         ``fleet/dispatch.py``) may append, ahead of the
-         coordinator-HA refactor. Locks don't excuse it (a second
+         ``fleet/dispatch.py``, and ``fleet/ha.py`` -- the
+         coordinator-role lease/takeover records) may append. Locks
+         don't excuse it (a second
          writer under a lock is still a second writer); escape with
          the standard ``# codelint: ok`` pragma.
 """
@@ -58,11 +59,14 @@ JOURNAL_METHODS = frozenset({"append_cell", "append_event"})
 #: path suffixes of the modules that ARE the coordinator role -- the
 #: only legal journal-append call sites (journal.py holds the
 #: implementation; scheduler.py and dispatch.py are the two
-#: coordinators)
+#: coordinators; ha.py appends the coordinator's OWN lease renewals
+#: and the takeover records that transfer the role, which are exactly
+#: the writes that make the role leasable)
 JOURNAL_WRITER_FILES = (
     os.path.join("campaign", "journal.py"),
     os.path.join("campaign", "scheduler.py"),
     os.path.join("fleet", "dispatch.py"),
+    os.path.join("fleet", "ha.py"),
 )
 
 #: method names that mutate their receiver in place

@@ -63,6 +63,15 @@ Codes:
   FL015 warning  a lease extended outside an artifact sync (the one
                  legitimate reason a finished cell may outlive its
                  TTL)
+  FL016 mixed    coordinator-lease chain audit (the HA protocol,
+                 fleet.ha): a takeover that doesn't name its true,
+                 stamp-expired predecessor lease under a distinct
+                 writer; a zombie renewal or append stamped with a
+                 pre-takeover epoch after the takeover record; a
+                 same-epoch append under a foreign writer (split
+                 brain) -- all errors. A scheduled coordinator-kill
+                 that left no takeover record is a warning (the kill
+                 vanished)
 
 Entry points: ``lint_campaign`` (diagnostics only), ``audit``
 (diagnostics + the persisted ``fleet_analysis.json`` report, byte
@@ -86,7 +95,8 @@ import os
 from .. import store
 from .diagnostics import (ERROR, INFO, WARNING, diag, errors,
                           severity_counts, to_json)
-from .fleetmodel import FORFEIT_EVENTS, CampaignModel, parse_t
+from .fleetmodel import (FORFEIT_EVENTS, HA_EVENTS, CampaignModel,
+                         parse_t)
 
 logger = logging.getLogger(__name__)
 
@@ -156,9 +166,14 @@ def _writer_diags(model):
     """FL004: the single-writer oracle. Writer identities must form
     contiguous runs (a resume hands the journal to a NEW writer; two
     interleaved writers were alive at once), and there should be no
-    more writers than resumes can explain."""
+    more writers than resumes can explain. Takeover-aware: the HA
+    role events (coordinator-lease / coordinator-takeover) are
+    excluded from the runs, so a losing standby's lone fence attempt
+    -- one takeover record wedged inside the winner's run -- is not
+    an interleaving; zombie appends that exclusion could hide are
+    caught by epoch in FL016 instead."""
     diags = []
-    runs = model.writer_runs()
+    runs = model.writer_runs(skip_ha=True)
     seen = set()
     for w, idx, _count in runs:
         if w in seen:
@@ -506,6 +521,192 @@ def _chaos_diags(model):
 
 
 # ---------------------------------------------------------------------------
+# coordinator-HA chain audit
+
+def _as_epoch(v):
+    return v if isinstance(v, int) and not isinstance(v, bool) else None
+
+
+def _ha_diags(model):
+    """FL016: replay the coordinator-lease chain (fleet.ha). One walk
+    over the journal tracks the authoritative ``(epoch, writer)``
+    exactly like ``ha.coordinator_state`` and checks every record
+    against it: takeovers must name their true, stamp-expired
+    predecessor under a distinct writer (``forced`` operator fences
+    skip the expiry requirement -- the operator is the evidence);
+    after a takeover, any record stamped with a pre-takeover epoch is
+    a zombie append the fencing race let through, and a same-epoch
+    record under a foreign writer is split brain. Losing fence
+    attempts (a second takeover naming an already-fenced predecessor)
+    are benign by themselves -- the loser standing down is exactly
+    what the split-brain check proves. Returns ``(diags,
+    takeovers_audited)``; a journal with no HA events yields
+    nothing."""
+    diags = []
+    has_ha = any(r.get("event") in HA_EVENTS for r in model.records)
+    if not has_ha:
+        prof = model.chaos_profile()
+        if prof is not None \
+                and getattr(prof, "coordinator_kill", 0) \
+                and model.status == "complete":
+            diags.append(diag(
+                "FL016", WARNING,
+                "chaos scheduled a coordinator-kill but the journal "
+                "has no coordinator-lease or takeover records: the "
+                "kill (or the whole HA protocol) vanished",
+                "campaign.chaos",
+                "coordinator-kill chaos needs --coordinator-lease-s "
+                "so a standby can fence the corpse"))
+        return diags, 0
+    epoch, writer = 0, None
+    taken = set()
+    lease_by_epoch = {}
+    audited = 0
+    for i, rec in enumerate(model.records):
+        ev = rec.get("event")
+        e = _as_epoch(rec.get("epoch"))
+        if ev == "coordinator-lease":
+            if e is None:
+                diags.append(diag(
+                    "FL016", ERROR,
+                    "coordinator-lease record without an integer "
+                    "epoch",
+                    f"journal[{i}]",
+                    "the epoch is the fencing token; a lease without "
+                    "one cannot be fenced"))
+                continue
+            if e > epoch:
+                epoch, writer = e, rec.get("writer")
+            elif e < epoch:
+                diags.append(diag(
+                    "FL016", ERROR,
+                    f"zombie coordinator renewal: lease at epoch {e} "
+                    f"appended while epoch {epoch} "
+                    f"({writer!r}) holds the role",
+                    f"journal[{i}]",
+                    "a fenced coordinator must refuse its own "
+                    "renewals once the takeover record lands"))
+            elif rec.get("writer") != writer:
+                diags.append(diag(
+                    "FL016", ERROR,
+                    f"split brain: epoch {e} renewed by "
+                    f"{rec.get('writer')!r} while held by {writer!r}",
+                    f"journal[{i}]",
+                    "two coordinators claimed the same epoch; the "
+                    "takeover protocol increments it"))
+            lease_by_epoch[e] = rec
+        elif ev == "coordinator-takeover":
+            audited += 1
+            prev = _as_epoch(rec.get("prev-epoch"))
+            if prev is not None and prev in taken:
+                continue        # a losing fence attempt: benign
+            if rec.get("prev-writer") is not None \
+                    and rec.get("writer") == rec.get("prev-writer"):
+                diags.append(diag(
+                    "FL016", ERROR,
+                    f"takeover by {rec.get('writer')!r} names ITSELF "
+                    "as the fenced predecessor: not a distinct "
+                    "writer",
+                    f"journal[{i}]",
+                    "a coordinator cannot fence itself; takeovers "
+                    "come from standbys (or a fresh --resume "
+                    "process)"))
+            if prev != epoch or (writer is not None
+                                 and rec.get("prev-writer") != writer):
+                diags.append(diag(
+                    "FL016", ERROR,
+                    f"takeover names predecessor epoch "
+                    f"{rec.get('prev-epoch')!r} writer "
+                    f"{rec.get('prev-writer')!r} but the journal's "
+                    f"authoritative state was epoch {epoch} "
+                    f"({writer!r})",
+                    f"journal[{i}]",
+                    "a fence must name the exact lease it expired; "
+                    "anything else means the standby read a stale "
+                    "journal"))
+            if not rec.get("forced"):
+                prev_lease = lease_by_epoch.get(prev)
+                if prev_lease is None:
+                    diags.append(diag(
+                        "FL016", ERROR,
+                        "takeover names no expired predecessor "
+                        f"lease (epoch {rec.get('prev-epoch')!r} "
+                        "never renewed)",
+                        f"journal[{i}]",
+                        "only an expired coordinator-lease justifies "
+                        "a fence; use a forced takeover for "
+                        "operator-driven handoffs"))
+                else:
+                    t_to = parse_t(rec.get("t"))
+                    t_lease = parse_t(prev_lease.get("t"))
+                    ttl = prev_lease.get("lease-s")
+                    ttl = float(ttl) if isinstance(ttl, (int, float)) \
+                        and not isinstance(ttl, bool) \
+                        else model.coordinator_lease_s
+                    allow = rec.get("skew-allowance-s")
+                    allow = float(allow) \
+                        if isinstance(allow, (int, float)) \
+                        and not isinstance(allow, bool) else 0.0
+                    if t_to is not None and t_lease is not None \
+                            and ttl is not None \
+                            and (t_to - t_lease) + allow \
+                            < ttl - TOLERANCE_S:
+                        diags.append(diag(
+                            "FL016", ERROR,
+                            f"premature takeover: the predecessor "
+                            f"lease was renewed {t_to - t_lease:.3f}s "
+                            f"before the fence (TTL {ttl:.1f}s, skew "
+                            f"allowance {allow:+.3f}s): the fenced "
+                            "coordinator may still have been alive",
+                            f"journal[{i}]",
+                            "standbys must wait out the full lease "
+                            "TTL (plus grace) on arrivals AND "
+                            "stamps before fencing"))
+            if e is not None and e > epoch:
+                if prev is not None:
+                    taken.add(prev)
+                epoch, writer = e, rec.get("writer")
+        elif e is not None and taken:
+            # an ordinary (cell / lease / sync) record stamped with a
+            # coordinator epoch, after at least one takeover
+            if e < epoch:
+                where = rec.get("cell") or ev or "?"
+                diags.append(diag(
+                    "FL016", ERROR,
+                    f"zombie append: record {i} ({where!r}) stamped "
+                    f"with pre-takeover epoch {e} after epoch "
+                    f"{epoch} ({writer!r}) fenced it",
+                    f"journal[{i}]",
+                    "the fenced coordinator's terminal-guard must "
+                    "re-check the journal before appending; this "
+                    "append slipped through the fencing race "
+                    "window"))
+            elif e == epoch and writer is not None \
+                    and rec.get("writer") != writer:
+                where = rec.get("cell") or ev or "?"
+                diags.append(diag(
+                    "FL016", ERROR,
+                    f"split brain: record {i} ({where!r}) at epoch "
+                    f"{e} from {rec.get('writer')!r} while the role "
+                    f"is held by {writer!r}",
+                    f"journal[{i}]",
+                    "a losing standby must go back to tailing, "
+                    "never append under the winner's epoch"))
+    prof = model.chaos_profile()
+    if prof is not None and getattr(prof, "coordinator_kill", 0) \
+            and model.status == "complete" and not model.takeovers():
+        diags.append(diag(
+            "FL016", WARNING,
+            "chaos scheduled a coordinator-kill but the journal has "
+            "no takeover record: the kill (or the standby's fence) "
+            "vanished",
+            "campaign.chaos",
+            "a killed coordinator's campaign can only complete "
+            "through a standby takeover"))
+    return diags, audited
+
+
+# ---------------------------------------------------------------------------
 # entry points
 
 def _lint_model(model):
@@ -518,6 +719,8 @@ def _lint_model(model):
     tdiags, audited, skipped = _trace_diags(model)
     diags += tdiags
     diags += _chaos_diags(model)
+    hdiags, ha_audited = _ha_diags(model)
+    diags += hdiags
     if skipped:
         diags.append(diag(
             "FL014", INFO,
@@ -541,6 +744,7 @@ def _lint_model(model):
         "cells_terminal": len(model.terminal_by_cell()),
         "runs_audited": audited,
         "runs_skipped": skipped,
+        "ha_takeovers_audited": ha_audited,
     }
     return diags, checks
 

@@ -28,12 +28,17 @@ from .. import store
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["CampaignModel", "RunTrace", "parse_t", "FORFEIT_EVENTS"]
+__all__ = ["CampaignModel", "RunTrace", "parse_t", "FORFEIT_EVENTS",
+           "HA_EVENTS"]
 
 #: journal event kinds that forfeit a cell's current lease (the legal
 #: predecessors of a steal: a re-grant without one of these between
 #: the grants means two live leases on one cell)
 FORFEIT_EVENTS = ("lease-failed", "lease-expired")
+
+#: the coordinator-HA role events (fleet.ha): renewals of the
+#: coordinator's own lease and the takeover records that fence it
+HA_EVENTS = ("coordinator-lease", "coordinator-takeover")
 
 
 def parse_t(stamp):
@@ -239,14 +244,21 @@ class CampaignModel:
                 out.append((i, kind, rec))
         return out
 
-    def writer_runs(self):
+    def writer_runs(self, skip_ha=False):
         """The journal's writer identities as contiguous runs:
         ``[(writer, first_index, count), ...]``. Records without a
         stamp (pre-upgrade journals) are skipped. A writer appearing
         in two non-adjacent runs means two coordinators interleaved
-        appends -- the single-writer violation."""
+        appends -- the single-writer violation. With ``skip_ha`` the
+        HA role events are excluded (indices still point into
+        ``self.records``): a losing standby's lone takeover record is
+        a fence attempt, not an interleaved coordinator -- zombie
+        appends hiding behind the exclusion are FL016's job, which
+        catches them by epoch instead of adjacency."""
         runs = []
         for i, rec in enumerate(self.records):
+            if skip_ha and rec.get("event") in HA_EVENTS:
+                continue
             w = rec.get("writer")
             if not w:
                 continue
@@ -255,6 +267,28 @@ class CampaignModel:
             else:
                 runs.append([str(w), i, 1])
         return [tuple(r) for r in runs]
+
+    # -- coordinator HA (fleet.ha) --------------------------------------
+
+    @property
+    def coordinator_lease_s(self):
+        v = (self.meta or {}).get("coordinator-lease-s")
+        return float(v) if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else None
+
+    def ha_leases(self):
+        """All coordinator-lease renewal events, append order."""
+        return self.events_of("coordinator-lease")
+
+    def takeovers(self):
+        """All coordinator-takeover (fence) events, append order."""
+        return self.events_of("coordinator-takeover")
+
+    def coordinator_state(self):
+        """The journal's authoritative ``(epoch, writer)`` (fleet.ha
+        fold; ``(0, None)`` for a pre-HA journal)."""
+        from ..fleet.ha import coordinator_state
+        return coordinator_state(self.records)
 
     def worker_offsets(self):
         """{worker: offset_s} -- the merge's per-worker median clock

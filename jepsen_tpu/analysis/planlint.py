@@ -121,6 +121,16 @@ Codes:
                  ``skip-offline?`` monitor -- info noting the
                  certifier is the ONLY independent check of the
                  monitor's verdict of record on that path
+  PL024 mixed    coordinator HA (fleet/ha.py): a non-positive /
+                 non-numeric --coordinator-lease-s or
+                 --takeover-grace-s, a renewal interval at or beyond
+                 the lease TTL it renews (the coordinator could
+                 never keep its own lease alive), a standby with no
+                 reachable store to tail, or coordinator-kill chaos
+                 with HA off (nothing could ever fence the corpse)
+                 -- errors; a coordinator lease TTL at or beyond the
+                 cell lease (detection slower than the work it
+                 guards) -- warning
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -141,7 +151,7 @@ logger = logging.getLogger(__name__)
 __all__ = ["lint_plan", "lint_campaign", "lint_fleet", "lint_service",
            "lint_telemetry", "lint_fleetlint", "lint_introspection",
            "lint_coalesce", "lint_capacity", "lint_trend",
-           "lint_certify", "preflight",
+           "lint_certify", "lint_ha", "preflight",
            "PlanLintError", "FATAL_CODES", "FLEETLINT_MODES",
            "monitor_diags", "searchplan_diags"]
 
@@ -1157,6 +1167,96 @@ def lint_fleetlint(cfg):
                 d.location,
                 d.fix_hint or "repair or quarantine the offending "
                               "cell's records before resuming"))
+    return diags
+
+
+def lint_ha(cfg):
+    """PL024: coordinator-HA preflight (fleet/ha.py), before any lease
+    is claimed or standby started. Recognized keys: ``ha?`` (whether a
+    coordinator lease will be claimed), ``coordinator-lease-s``,
+    ``takeover-grace-s``, ``renew-interval-s`` (the renewal heartbeat
+    period, when explicitly configured), ``standby?`` +
+    ``store-reachable?`` (a standby needs a journal it can tail), and
+    ``chaos-coordinator-kill?`` (whether coordinator-kill chaos is
+    scheduled). The failover math is checked statically: a renewal
+    interval at or beyond the lease TTL guarantees self-fencing, and a
+    coordinator-kill with HA off guarantees a hung campaign -- both
+    are cheaper to refuse here than to soak-test into."""
+    diags = []
+    cfg = cfg or {}
+    lease = cfg.get("coordinator-lease-s")
+    if lease is not None and (not isinstance(lease, (int, float))
+                              or isinstance(lease, bool) or lease <= 0):
+        diags.append(diag(
+            "PL024", ERROR,
+            f"--coordinator-lease-s must be a positive number, got "
+            f"{lease!r}",
+            "ha.coordinator-lease-s",
+            "the coordinator lease TTL is the coordinator-death "
+            "detection bound; non-positive means every standby fences "
+            "a live coordinator instantly"))
+        lease = None
+    grace = cfg.get("takeover-grace-s")
+    if grace is not None and (not isinstance(grace, (int, float))
+                              or isinstance(grace, bool) or grace <= 0):
+        diags.append(diag(
+            "PL024", ERROR,
+            f"--takeover-grace-s must be a positive number, got "
+            f"{grace!r}",
+            "ha.takeover-grace-s",
+            "the grace pad absorbs renewal jitter and clock skew "
+            "before a standby fences; omit the flag for the default"))
+    renew = cfg.get("renew-interval-s")
+    if renew is not None and (not isinstance(renew, (int, float))
+                              or isinstance(renew, bool) or renew <= 0):
+        diags.append(diag(
+            "PL024", ERROR,
+            f"coordinator renew interval must be a positive number, "
+            f"got {renew!r}",
+            "ha.renew-interval-s"))
+        renew = None
+    if renew is not None and lease is not None and renew >= lease:
+        diags.append(diag(
+            "PL024", ERROR,
+            f"coordinator renew interval {renew:g}s >= lease TTL "
+            f"{lease:g}s: the coordinator cannot renew its own lease "
+            "before it expires, so a healthy coordinator is fenced by "
+            "the first standby to look",
+            "ha.renew-interval-s",
+            "keep the renewal period well under the lease TTL "
+            "(fleet.ha renews every TTL/3 by default)"))
+    if cfg.get("standby?") and cfg.get("store-reachable?") is False:
+        diags.append(diag(
+            "PL024", ERROR,
+            "--standby with no reachable campaign store: a standby is "
+            "a journal tail, and there is no journal to tail",
+            "ha.standby",
+            "point --store-dir at the shared store the active "
+            "coordinator writes (NFS mount, shared volume), or start "
+            "the standby on the coordinator's host"))
+    if cfg.get("chaos-coordinator-kill?") and not cfg.get("ha?"):
+        diags.append(diag(
+            "PL024", ERROR,
+            "coordinator-kill chaos with HA off: the kill would "
+            "SIGKILL the only coordinator and nothing could ever "
+            "fence the corpse or finish the campaign",
+            "ha.chaos",
+            "pass --coordinator-lease-s (and run a --standby) so a "
+            "takeover can survive the kill, or drop the "
+            "coordinator-kill fault"))
+    cell_lease = cfg.get("lease-s")
+    if lease is not None and isinstance(cell_lease, (int, float)) \
+            and not isinstance(cell_lease, bool) \
+            and 0 < cell_lease <= lease:
+        diags.append(diag(
+            "PL024", WARNING,
+            f"coordinator-lease-s {lease:g} >= cell lease-s "
+            f"{cell_lease:g}: detecting a dead coordinator takes "
+            "longer than detecting a dead worker, so every in-flight "
+            "cell lease expires before the standby takes over",
+            "ha.coordinator-lease-s",
+            "keep the coordinator lease TTL under the cell lease so "
+            "takeover wins the race against mass cell expiry"))
     return diags
 
 

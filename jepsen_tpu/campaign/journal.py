@@ -57,6 +57,11 @@ class CampaignJournal:
         self.dir = store.campaign_path(self.campaign_id)
         os.makedirs(self.dir, exist_ok=True)
         self.writer = writer_id()
+        #: coordinator epoch (fleet.ha): when set, every appended
+        #: record is stamped with it so the FL016 chain audit can
+        #: prove post hoc that no fenced (pre-takeover) coordinator's
+        #: append slipped in after the takeover record
+        self.epoch = None
         self._lock = threading.Lock()
 
     # -- paths ----------------------------------------------------------
@@ -120,6 +125,8 @@ class CampaignJournal:
         # purpose); setdefault on a copy -- the caller's dict is theirs
         record = dict(record)
         record.setdefault("writer", self.writer)
+        if self.epoch is not None:
+            record.setdefault("epoch", self.epoch)
         line = json.dumps(record, cls=store._Encoder)
         with self._lock:
             torn = False

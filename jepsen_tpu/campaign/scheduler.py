@@ -199,6 +199,19 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
                 diag_errors(pf),
                 title="--resume refused: journal fails the fleetlint "
                       "preflight:"))
+    if resume:
+        # an HA (fleet.ha) journal must be resumed through the FLEET
+        # path: the scheduler has no coordinator lease, so its appends
+        # would carry no epoch stamp and no fencing -- a live standby
+        # could take over mid-resume and both would write
+        from ..fleet import ha as fha
+        cur_epoch = fha.current_epoch(jr.records())
+        if cur_epoch:
+            raise CampaignError(
+                f"--resume: campaign {campaign_id!r} is coordinator-HA "
+                f"(epoch {cur_epoch}): resume it in fleet mode "
+                "(--workers ...) so the prior epoch is fenced with a "
+                "journaled takeover record first")
     done = jr.completed() if resume else {}
     if resume:
         # compare EVERY journaled cell (terminal or aborted) against
@@ -211,7 +224,10 @@ def run_cells(cells, *, campaign_id=None, parallel=1, device_slots=1,
                 f"--resume: journal has cells not in this plan "
                 f"{sorted(unknown)} -- same campaign id, different "
                 "matrix?")
+    # spread the prior meta first: a resume must not strip keys a
+    # prior (possibly newer) coordinator recorded alongside ours
     jr.write_meta({
+        **(prior or {}),
         "status": "running",
         "created": (prior or {}).get("created") or store.local_time(),
         "updated": store.local_time(),

@@ -554,6 +554,30 @@ def _add_campaign_opts(parser, axes=False):
                                  "the dispatch control plane; "
                                  "profiles: none, flaky-exec, "
                                  "lossy-sync, soak (e.g. soak:42).")
+        parser.add_argument("--coordinator-lease-s", type=float,
+                            default=None, metavar="SECONDS",
+                            help="Coordinator HA (fleet.ha): renew a "
+                                 "journaled coordinator-role lease "
+                                 "with this TTL so a standby can "
+                                 "detect coordinator death and take "
+                                 "the campaign over (default: HA "
+                                 "off; PL024 rejects non-positive "
+                                 "values).")
+        parser.add_argument("--takeover-grace-s", type=float,
+                            default=None, metavar="SECONDS",
+                            help="Extra quiet time a standby waits "
+                                 "past the coordinator lease TTL "
+                                 "before fencing (default 5; PL024 "
+                                 "rejects non-positive values).")
+        parser.add_argument("--standby", action="store_true",
+                            help="Run as a standby coordinator: tail "
+                                 "the campaign journal read-only; on "
+                                 "coordinator-lease expiry, fence the "
+                                 "dead coordinator (journaled "
+                                 "takeover record) and resume the "
+                                 "campaign. Without --campaign-id "
+                                 "the most recent campaign is "
+                                 "tailed.")
         parser.add_argument("--axis", action="append", default=[],
                             metavar="NAME=V1,V2,...",
                             help="A sweep axis: option NAME takes each "
@@ -677,6 +701,7 @@ _FLEET_LOCAL_OPTS = {
     "device-slots", "campaign-id", "resume", "lint?",
     "no-coalesce", "coalesce-window-ms", "coalesce-max-segments",
     "capacity", "device-mem-budget",
+    "standby", "coordinator-lease-s", "takeover-grace-s",
 }
 
 
@@ -792,10 +817,11 @@ def campaign_cmd(opts):
             "trace-merge?": workers is not None
             and not options.get("no-trace-merge"),
         })
+        chaos_prof = None
         if options.get("chaos-profile"):
             from .fleet import chaos as fchaos
             try:
-                fchaos.parse(options["chaos-profile"])
+                chaos_prof = fchaos.parse(options["chaos-profile"])
             except ValueError as e:
                 raise CliError(str(e)) from None
         # searchplan knob preflight (PL015) rides along over the base
@@ -822,6 +848,27 @@ def campaign_cmd(opts):
                 options.get("coalesce-max-segments"),
             "device-slots": options.get("device-slots"),
             "engine": options.get("engine"),
+        })
+        # coordinator-HA preflight (PL024) rides the same way: broken
+        # failover math (a coordinator-kill with HA off, a standby
+        # with no journal to tail) surfaces at --lint, before any
+        # role lease is claimed or standby started
+        standby = bool(options.get("standby"))
+        standby_cid = (options.get("campaign-id")
+                       or store.latest_campaign()) if standby else None
+        diags += analysis.planlint.lint_ha({
+            "ha?": options.get("coordinator-lease-s") is not None
+            or standby,
+            "coordinator-lease-s": options.get("coordinator-lease-s"),
+            "takeover-grace-s": options.get("takeover-grace-s"),
+            "standby?": standby,
+            "store-reachable?": bool(
+                standby_cid and os.path.exists(store.campaign_path(
+                    standby_cid, "campaign.json"))) if standby
+            else None,
+            "chaos-coordinator-kill?": bool(
+                getattr(chaos_prof, "coordinator_kill", 0)),
+            "lease-s": options.get("lease"),
         })
         # capacity preflight (PL021 + CP001-CP008, analysis.capplan):
         # the whole-campaign static plan -- every compile shape, HBM
@@ -881,6 +928,46 @@ def campaign_cmd(opts):
                     "and make sure the matrix has known-shape cells)")
             logger.info("--device-slots auto -> %d", resolved)
             options["device-slots"] = resolved
+        # coordinator HA (fleet.ha): the standby tails the journal
+        # read-only until the active coordinator's lease expires,
+        # fences it with a journaled takeover record, and falls
+        # through to the normal fleet --resume path as the new
+        # coordinator (epoch = the won fencing token)
+        ha_epoch = None
+        if standby:
+            if workers is None:
+                raise CliError(
+                    "--standby is fleet-mode only: pass --workers so "
+                    "a takeover can dispatch the remaining cells")
+            if not standby_cid:
+                raise CliError(
+                    "--standby: no campaign to stand by for (pass "
+                    "--campaign-id, or start the active coordinator "
+                    "first)")
+            from .fleet import ha as fha
+            sb = fha.Standby(
+                standby_cid,
+                lease_s=options.get("coordinator-lease-s"),
+                grace_s=options.get("takeover-grace-s"))
+            print(f"standby: tailing campaign {standby_cid}",
+                  flush=True)
+            status, epoch = sb.wait()
+            if status == "complete":
+                print(f"standby: campaign {standby_cid} completed "
+                      "under its own coordinator; standing down")
+                sys.exit(0)
+            print(f"standby: coordinator lease expired; took over "
+                  f"campaign {standby_cid} at epoch {epoch}",
+                  flush=True)
+            ha_epoch = epoch
+            options["campaign-id"] = standby_cid
+            options["resume"] = True
+        elif options.get("coordinator-lease-s") is not None \
+                and workers is None:
+            raise CliError(
+                "--coordinator-lease-s is fleet-mode only: the "
+                "coordinator role lease lives in the fleet journal "
+                "(pass --workers, e.g. --workers local,local)")
         if options.get("serve"):
             from . import web
             web.serve({"ip": options.get("serve-ip", "0.0.0.0"),
@@ -924,7 +1011,11 @@ def campaign_cmd(opts):
                         "coalesce-max-segments"),
                     capacity=capacity,
                     device_mem_budget=budget,
-                    capacity_plan=cap_plan)
+                    capacity_plan=cap_plan,
+                    coordinator_lease_s=options.get(
+                        "coordinator-lease-s"),
+                    takeover_grace_s=options.get("takeover-grace-s"),
+                    ha_epoch=ha_epoch)
             except fleet.FleetError as e:
                 raise CliError(str(e)) from e
             print(campaign.report.render_text(report))

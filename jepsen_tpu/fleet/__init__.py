@@ -37,7 +37,7 @@ Submodules that pull in the heavy harness chain load lazily;
 from __future__ import annotations
 
 _LAZY = ("ledger", "worker", "dispatch", "service", "backends",
-         "sync", "chaos")
+         "sync", "chaos", "ha")
 
 
 def __getattr__(name):
@@ -53,5 +53,5 @@ def __getattr__(name):
 
 
 __all__ = ["ledger", "worker", "dispatch", "service", "backends",
-           "sync", "chaos", "run_fleet", "FleetError",
+           "sync", "chaos", "ha", "run_fleet", "FleetError",
            "parse_workers"]

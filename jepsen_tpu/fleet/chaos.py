@@ -19,7 +19,11 @@ schedule of
   mid-run and the cell is stolen;
 * **a torn ledger tail** -- a partial line appended to the persistent
   compile ledger before the campaign starts, exercising the
-  torn-tail tolerance for real.
+  torn-tail tolerance for real;
+* **a coordinator kill** -- SIGKILL the dispatcher itself right after
+  a seeded cell's lease-grant append, leaving a half-run campaign with
+  a live lease and a dead coordinator: the `fleet.ha` standby's whole
+  reason to exist.
 
 Faults are injected through `control.remotes.FaultyRemote`; this
 module only decides *when*. Per-worker schedules derive from
@@ -76,6 +80,11 @@ class ChaosProfile:
     kills: int = 0
     #: append a torn fragment to the compile ledger at campaign start
     torn_ledger_tail: bool = False
+    #: SIGKILL the ACTIVE COORDINATOR right after a seeded cell's
+    #: lease grant lands in the journal (once per campaign, die-once
+    #: marker): the fleet.ha standby must detect the dead lease, fence
+    #: the coordinator, and finish the campaign
+    coordinator_kill: int = 0
 
     def with_seed(self, seed):
         return dataclasses.replace(self, seed=int(seed))
@@ -138,6 +147,22 @@ class ChaosProfile:
         rng = random.Random(f"{self.seed}|kills")
         return set(rng.sample(ids, n))
 
+    def plan_coordinator_kill(self, cell_ids):
+        """The deterministic cell whose lease-grant append is the
+        coordinator's last act (dispatch SIGKILLs itself right after
+        journaling that grant), or None when this profile doesn't kill
+        the coordinator. The first cell (sorted order) is skipped when
+        there is any other choice so the kill lands MID-campaign --
+        after some cells already ran -- which is the interesting
+        takeover case."""
+        ids = sorted(str(c) for c in cell_ids)
+        if not self.coordinator_kill or not ids:
+            return None
+        rng = random.Random(f"{self.seed}|coordinator-kill")
+        if len(ids) > 1:
+            return ids[rng.randrange(1, len(ids))]
+        return ids[0]
+
 
 #: the named shapes ``--chaos-profile`` accepts. "soak" is the CI /
 #: bench shape: a couple of exec exit-255s, one hang per worker, one
@@ -160,6 +185,8 @@ PROFILES = {
         hang_p=0.4, hang_max=1, hang_s=2.0,
         download_partial_p=0.5, download_partial_max=1,
         kills=1, torn_ledger_tail=True),
+    "coordinator-kill": ChaosProfile(
+        name="coordinator-kill", coordinator_kill=1),
 }
 
 

@@ -37,6 +37,7 @@ import collections
 import json
 import logging
 import os
+import signal
 import threading
 import time
 import traceback
@@ -114,7 +115,9 @@ def parse_workers(spec, ssh=None):
     an explicit worker id; repeated bare hosts auto-suffix (``local``,
     ``local#2``) so N loopback worker processes coexist. ``local`` /
     ``localhost`` use the LocalRemote transport; anything else is an
-    SSH host resolved with the suite's ssh options."""
+    SSH host resolved with the suite's ssh options. A ``host:port``
+    suffix overrides the ssh port per worker (the docker fleet's
+    sshd containers all live on 127.0.0.1 behind different ports)."""
     if isinstance(spec, str):
         entries = [e.strip() for e in spec.split(",") if e.strip()]
     else:
@@ -132,8 +135,12 @@ def parse_workers(spec, ssh=None):
         seen[wid] = seen.get(wid, 0) + 1
         if seen[wid] > 1 and not eq:
             wid = f"{wid}#{seen[wid]}"
+        wconn = conn
+        h, sep, port = host.rpartition(":")
+        if sep and port.isdigit():
+            host, wconn = h, dict(conn, port=int(port))
         kind = "local" if host in LOCAL_HOSTS else "ssh"
-        out.append(Worker(wid, host, kind=kind, conn_spec=conn))
+        out.append(Worker(wid, host, kind=kind, conn_spec=wconn))
     return out
 
 
@@ -153,7 +160,9 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
               serve_ip=None, auth_token=None, trace_merge=True,
               fleetlint="on", coalesce=False, coalesce_window_ms=None,
               coalesce_max_segments=None, capacity=None,
-              device_mem_budget=None, capacity_plan=None):
+              device_mem_budget=None, capacity_plan=None,
+              coordinator_lease_s=None, takeover_grace_s=None,
+              ha_epoch=None):
     """Run a campaign across worker hosts; returns the report dict
     (persisted as report.json, same shape as scheduler.run_cells).
 
@@ -217,7 +226,22 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     compile shapes the campaign actually noted (persistent-ledger
     delta + the coordinator's own) into ``report["capacity"]`` -- the
     prediction oracle. ``plan``/``warn`` are CONTAINED: findings and
-    planner crashes can never flip a cell outcome or the exit code."""
+    planner crashes can never flip a cell outcome or the exit code.
+
+    **Coordinator HA** (``coordinator_lease_s`` / ``takeover_grace_s``
+    / ``ha_epoch``): with a coordinator lease TTL set, THIS
+    coordinator's role becomes a journaled lease (fleet.ha): it
+    claims a coordinator epoch, stamps every journal append with it,
+    renews a ``coordinator-lease`` event on a heartbeat, and rechecks
+    the journal at the terminal-guard so a fenced (superseded)
+    coordinator refuses its own late appends and stands down instead
+    of finalizing. A standby that won a takeover resumes with
+    ``resume=True, ha_epoch=<won epoch>``; a manual ``--resume`` of
+    an HA campaign fences the prior epoch with a ``forced`` takeover
+    record. The ``coordinator-kill`` chaos profile SIGKILLs this
+    process right after a seeded cell's lease-grant append (die-once
+    marker), which is what the HA soak and bench rung 14 recover
+    from."""
     from ..analysis import planlint, render_text, errors as diag_errors
     from . import sync as fsync
 
@@ -284,6 +308,17 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         "device-slots": device_slots,
         "engine": base_options.get("engine"),
     })
+    # PL024: coordinator-HA knobs ride along (non-positive lease /
+    # grace TTLs, a renewal interval that can't beat its own lease,
+    # coordinator-kill chaos with no HA lease for a standby to fence)
+    diags += planlint.lint_ha({
+        "ha?": coordinator_lease_s is not None or ha_epoch is not None,
+        "coordinator-lease-s": coordinator_lease_s,
+        "takeover-grace-s": takeover_grace_s,
+        "chaos-coordinator-kill?": bool(
+            getattr(chaos, "coordinator_kill", 0))
+        if chaos is not None else False,
+    })
     # PL021 + the capacity plan (analysis.capplan): the static pass
     # over the cells' params x ModelSpecs -- predicted compile shapes,
     # HBM vs budget, int32 wall -- before any host is contacted. Only
@@ -343,6 +378,39 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                 diag_errors(pf),
                 title="--resume refused: journal fails the fleetlint "
                       "preflight:"))
+    # coordinator HA (fleet.ha): claim an epoch BEFORE any other
+    # append so every record this process writes is epoch-stamped.
+    # HA is on when a lease TTL was asked for, when a standby hands
+    # us its won epoch, or when the journal already carries HA events
+    # (resuming an HA campaign without the flag must not silently
+    # strip the fencing)
+    from . import ha as fha
+    ha_on = (coordinator_lease_s is not None or ha_epoch is not None
+             or (resume and fha.current_epoch(jr.records()) > 0))
+    ha_ctl = None
+    if ha_on:
+        if coordinator_lease_s is None:
+            coordinator_lease_s = fha.DEFAULT_COORDINATOR_LEASE_S
+        if takeover_grace_s is None:
+            takeover_grace_s = fha.DEFAULT_TAKEOVER_GRACE_S
+        if ha_epoch is None:
+            cur = fha.current_epoch(jr.records())
+            if cur and resume:
+                # a MANUAL --resume of an HA campaign: the operator is
+                # the takeover evidence, so fence the prior epoch with
+                # a forced takeover record (FL016 skips the stamp
+                # expiry requirement for forced fences)
+                ha_epoch = fha.fence(jr, reason="manual-resume",
+                                     forced=True)
+                if ha_epoch is None:
+                    raise FleetError(
+                        f"--resume: lost the coordinator takeover "
+                        f"race for campaign {campaign_id!r} -- another "
+                        "coordinator fenced it first")
+            else:
+                ha_epoch = cur + 1
+        jr.epoch = int(ha_epoch)
+
     done = jr.completed() if resume else {}
     jr.write_meta({
         "status": "running", "mode": "fleet",
@@ -355,6 +423,9 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         "sync-timeout-s": sync_timeout_s,
         **({"worker-store": str(worker_store_dir)}
            if worker_store_dir else {}),
+        **({"coordinator-lease-s": coordinator_lease_s,
+            "takeover-grace-s": takeover_grace_s,
+            "ha-epoch": int(ha_epoch)} if ha_on else {}),
         **({"chaos": chaos.describe()} if chaos is not None else {}),
         "resumes": ((prior or {}).get("resumes") or 0)
         + (1 if resume else 0),
@@ -375,6 +446,22 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
     except Exception:  # noqa: BLE001 - journals are insurance
         logger.warning("couldn't attach fleet telemetry journals",
                        exc_info=True)
+    if ha_on:
+        def _on_fenced(state):
+            # a standby fenced us: stop leasing immediately (the
+            # latch drains in-flight cells); the terminal-guard below
+            # refuses whatever results still arrive
+            if not latch.is_set():
+                latch.set(f"fenced: coordinator epoch {jr.epoch} "
+                          f"superseded by epoch {state[0]} "
+                          f"({state[1]})")
+            tr.instant("fleet.ha.fenced", cat="fleet",
+                       args={"epoch": jr.epoch,
+                             "by-epoch": state[0],
+                             "by-writer": str(state[1])})
+        ha_ctl = fha.CoordinatorLease(
+            jr, lease_s=coordinator_lease_s, epoch=jr.epoch,
+            registry=reg, on_fenced=_on_fenced)
     led = None
     if ledger:
         try:
@@ -409,6 +496,15 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         return worker.kind != "local" or worker_store != store_dir
 
     kill_cells = chaos.plan_kills(ids) if chaos is not None else set()
+    # chaos coordinator-kill: one seeded cell whose lease-grant append
+    # is this coordinator's last act (SIGKILL right after it hits the
+    # journal). The marker file makes it die-once -- the takeover
+    # coordinator resuming the same campaign+profile runs clean
+    coord_kill_cell, coord_kill_marker = None, None
+    if chaos is not None and getattr(chaos, "coordinator_kill", 0):
+        coord_kill_marker = fha.takeover_marker(campaign_id)
+        if not os.path.exists(coord_kill_marker):
+            coord_kill_cell = chaos.plan_coordinator_kill(ids)
     if chaos is not None and chaos.torn_ledger_tail and led is not None:
         from . import chaos as fchaos
         fchaos.tear_ledger_tail(led)
@@ -430,6 +526,18 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
             reg.inc("fleet.stale_results")
             logger.info("dropping stale result for already-terminal "
                         "cell %s", cid)
+            return False
+        if ha_ctl is not None and ha_ctl.fenced(refresh=True):
+            # zombie fencing: re-read the journal at the last moment
+            # before the outcome append -- a takeover record means a
+            # standby owns this campaign (and this cell) now, and OUR
+            # append would be the exact split-brain FL016 exists to
+            # catch. Refuse it and drain
+            reg.inc("fleet.fenced_appends")
+            logger.warning("refusing outcome append for %s: "
+                           "coordinator epoch %s is fenced", cid,
+                           jr.epoch)
+            cond.notify_all()
             return False
         terminal.add(cid)
         jr.append_cell(rec)
@@ -496,6 +604,8 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         budget, journal the cell crashed. Caller holds ``cond``."""
         if cid in terminal:
             return
+        if ha_ctl is not None and ha_ctl.fenced():
+            return      # fenced: the standby owns the cell now
         jr.append_event({"event": "lease-failed", "cell": cid,
                          "worker": worker_id, "error": str(error)[:500],
                          "t": store.local_time()})
@@ -568,6 +678,11 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
             safe = str(cell["id"]).replace(os.sep, "_")
             spec["die-once-marker"] = os.path.abspath(
                 store.campaign_path(campaign_id, f"chaos-kill-{safe}"))
+        if ha_ctl is not None:
+            # the fencing token: workers echo it back on their result
+            # record, so even a record relayed through a zombie
+            # coordinator names the epoch that leased it
+            spec["coordinator-epoch"] = jr.epoch
         if backends is not None:
             spec["backend"] = backends.choose()
         return spec
@@ -635,6 +750,8 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
 
     def run_lease(worker, conn, cell):
         cid = cell["id"]
+        if ha_ctl is not None and ha_ctl.fenced():
+            return False, {}    # superseded: grant nothing more
         lease = table.grant(cid, worker.id, lease_s)
         jr.append_event({"event": "lease", "cell": cid,
                          "worker": worker.id, "lease-s": lease_s,
@@ -643,6 +760,22 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
         tr.instant("fleet.lease.grant", cat="fleet",
                    args={"cell": cid, "worker": worker.id,
                          "attempt": lease.attempt})
+        if coord_kill_cell is not None and cid == coord_kill_cell:
+            # chaos coordinator-kill: that grant was this process's
+            # last act. Drop the die-once marker (flushed to disk so
+            # the takeover coordinator never re-fires the kill), then
+            # die the way a real coordinator dies -- no cleanup, no
+            # journal goodbye, a live lease left dangling
+            try:
+                with open(coord_kill_marker, "w") as f:
+                    f.write(f"{os.getpid()} {cid}\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+            except OSError:  # pragma: no cover - marker is best effort
+                pass
+            logger.warning("chaos: coordinator-kill on cell %s "
+                           "(SIGKILL self)", cid)
+            os.kill(os.getpid(), signal.SIGKILL)
         reg.set_gauge("fleet.lease_active", len(table.active()))
         spec = cell_spec(cell, worker, attempt=lease.attempt)
         ctx = {"dir": cwd, "timeout": lease_s}
@@ -931,6 +1064,10 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                              args={"id": campaign_id,
                                    "cells": len(pending),
                                    "workers": len(workers)}):
+                    if ha_ctl is not None:
+                        # the claiming renewal lands before any cell
+                        # lease: the journal carries the epoch first
+                        ha_ctl.start()
                     watchdog.start()
                     threads = [threading.Thread(
                         target=worker_loop, args=(w,),
@@ -949,6 +1086,18 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
                            campaign_id, e)
         finally:
             watchdog.stop()
+            if ha_ctl is not None:
+                ha_ctl.stop()
+
+        if ha_ctl is not None and ha_ctl.fenced(refresh=True):
+            # stand down WITHOUT touching campaign.json / report.json:
+            # the winning coordinator owns them now. Our journal
+            # appends are all epoch-stamped, so FL016 can audit
+            # anything that slipped through the fencing race window
+            raise FleetError(
+                f"coordinator fenced: epoch {jr.epoch} superseded by "
+                f"{ha_ctl.fenced_by}; standing down (the campaign "
+                "continues under the new coordinator)")
 
         unfinished = set(ids) - terminal
         if unfinished and not latch.is_set():

@@ -100,6 +100,11 @@ def run_cell_spec(spec):
     rec = {"cell": cid, "group": spec.get("group") or cid,
            "params": params, "worker": spec.get("worker"),
            "pid": os.getpid(),
+           # echo the coordinator's fencing token (fleet.ha): the
+           # record names the epoch that leased it even when it is
+           # relayed through a zombie coordinator's journal append
+           **({"coordinator-epoch": spec["coordinator-epoch"]}
+              if spec.get("coordinator-epoch") is not None else {}),
            "clock": {"worker-received-epoch": received_epoch,
                      **({"coord-sent-epoch":
                          tctx["coord-sent-epoch"]}

@@ -40,10 +40,10 @@ preserved byte-for-byte on the happy path.
 from __future__ import annotations
 
 from .abort import AbortLatch, ChainedLatch, signal_scope
-from .leases import Lease, LeaseTable, LeaseWatchdog
+from .leases import HeartbeatLoop, Lease, LeaseTable, LeaseWatchdog
 from .retry import RetryPolicy
 from .watchdog import OpWatchdog, WATCHDOG_FIRED
 
 __all__ = ["AbortLatch", "ChainedLatch", "signal_scope", "RetryPolicy",
            "OpWatchdog", "WATCHDOG_FIRED", "Lease", "LeaseTable",
-           "LeaseWatchdog"]
+           "LeaseWatchdog", "HeartbeatLoop"]

@@ -27,7 +27,7 @@ import time
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["Lease", "LeaseTable", "LeaseWatchdog"]
+__all__ = ["Lease", "LeaseTable", "LeaseWatchdog", "HeartbeatLoop"]
 
 
 @dataclasses.dataclass
@@ -109,6 +109,58 @@ class LeaseTable:
         with self._lock:
             return [lease for lease in self._leases.values()
                     if lease.deadline <= now]
+
+
+class HeartbeatLoop:
+    """Periodic heartbeat thread: calls ``beat()`` every
+    ``interval_s`` until stopped or until ``beat`` returns False (the
+    holder discovered it lost whatever role the heartbeat renews).
+    The inverse of `LeaseWatchdog`: the watchdog watches OTHERS'
+    leases expire; this keeps the caller's own lease alive. The fleet
+    coordinator's HA role (fleet.ha.CoordinatorLease) renews its
+    journaled coordinator-lease through one of these.
+
+    ``beat`` exceptions are contained per tick -- a transient journal
+    write failure must not kill the renewal loop whose silence would
+    trigger a takeover -- but ``on_stop`` (if given) fires exactly
+    once when the loop exits for any reason besides ``stop()``."""
+
+    def __init__(self, beat, interval_s, name="jepsen heartbeat",
+                 on_stop=None):
+        self.beat = beat
+        self.interval_s = float(interval_s)
+        self.name = str(name)
+        self.on_stop = on_stop
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=self.name)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                alive = self.beat()
+            except Exception:  # noqa: BLE001 - contained per tick
+                logger.warning("heartbeat %r: beat crashed (contained)",
+                               self.name, exc_info=True)
+                continue
+            if alive is False:
+                if self.on_stop is not None and not self._stop.is_set():
+                    try:
+                        self.on_stop()
+                    except Exception:  # noqa: BLE001 - contained
+                        logger.warning("heartbeat %r: on_stop crashed",
+                                       self.name, exc_info=True)
+                return
+
+    def stop(self, join_s=5.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=join_s)
 
 
 class LeaseWatchdog:

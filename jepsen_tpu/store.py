@@ -154,10 +154,21 @@ class _Encoder(json.JSONEncoder):
 
 
 def _dump_json(data, p):
+    # fsync BEFORE the rename: os.replace is atomic in the namespace
+    # but says nothing about the data blocks. A kill -9 (or power cut)
+    # between write and rename used to be able to publish a
+    # stale-but-valid file whose bytes never reached disk -- for
+    # campaign.json that meant a meta silently disagreeing with the
+    # fsync'd journal tail it claims to summarize.
     tmp = p + ".tmp"
     with open(tmp, "w") as f:
         json.dump(data, f, indent=1, cls=_Encoder)
         f.write("\n")
+        f.flush()
+        try:
+            os.fsync(f.fileno())
+        except OSError:  # pragma: no cover - exotic fs
+            pass
     os.replace(tmp, p)
 
 

@@ -1,0 +1,624 @@
+"""Coordinator HA tests (fleet/ha.py + the FL016 chain audit + PL024):
+the coordinator role as a leased, failover-able identity in the
+journal. Covers the epoch fold, fence races (double-standby), zombie
+fencing at the lease/renewal layer, skew-immune standby detection,
+chaos coordinator-kill determinism, the torn-rewrite fsync regression,
+the scheduler's HA-resume refusal, and THE acceptance run: SIGKILL the
+live coordinator mid-campaign and let a standby fence it, resume, and
+finish with exactly one terminal per cell and a clean audit."""
+
+import datetime
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import store
+from jepsen_tpu.analysis import fleetlint, planlint
+from jepsen_tpu.analysis.diagnostics import ERROR, WARNING
+from jepsen_tpu.campaign import compile_cache, plan, scheduler
+from jepsen_tpu.campaign.journal import CampaignJournal
+from jepsen_tpu.fleet import chaos as fchaos
+from jepsen_tpu.fleet import dispatch, ha
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+    compile_cache.reset()
+    yield
+    compile_cache.reset()
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+def _error_codes(diags):
+    return [d.code for d in diags if d.severity == ERROR]
+
+
+def _stamp(offset_s=0.0):
+    """A journal ``t`` stamp offset from now (negative = past)."""
+    return store.local_time(datetime.datetime.now().astimezone()
+                            + datetime.timedelta(seconds=offset_s))
+
+
+def mk_ha(cid, status="running", **extra):
+    jr = CampaignJournal(cid)
+    jr.write_meta({"status": status, "mode": "fleet",
+                   "cells": ["a", "b"], "workers": ["w1"],
+                   "lease-s": 60.0, "max-leases": 3,
+                   "coordinator-lease-s": 5.0, **extra})
+    return jr
+
+
+def lease(jr, epoch, writer=None, t=None, lease_s=5.0):
+    rec = {"event": ha.LEASE_EVENT, "epoch": epoch,
+           "lease-s": lease_s, "t": t or store.local_time()}
+    if writer is not None:
+        rec["writer"] = writer
+    jr.append_event(rec)
+
+
+# ---------------------------------------------------------------------------
+# the epoch fold + fence races
+
+
+def test_coordinator_state_fold_is_monotone_and_first_fence_wins():
+    recs = [
+        {"event": "coordinator-lease", "epoch": 1, "writer": "a:1"},
+        {"event": "coordinator-lease", "epoch": 1, "writer": "a:1"},
+        # first takeover claiming prev-epoch 1 wins...
+        {"event": "coordinator-takeover", "epoch": 2, "prev-epoch": 1,
+         "writer": "b:2"},
+        # ...a second claim of the SAME predecessor is a losing race
+        {"event": "coordinator-takeover", "epoch": 3, "prev-epoch": 1,
+         "writer": "c:3"},
+        # a zombie re-claim of an old epoch changes nothing
+        {"event": "coordinator-lease", "epoch": 1, "writer": "a:1"},
+    ]
+    assert ha.coordinator_state(recs) == (2, "b:2")
+    assert ha.current_epoch(recs) == 2
+    assert ha.current_epoch([]) == 0
+    assert ha.coordinator_state(None) == (0, None)
+    # non-HA journals fold to (0, None)
+    assert ha.coordinator_state([{"cell": "a", "outcome": True}]) \
+        == (0, None)
+
+
+def test_fence_appends_takeover_and_detects_a_lost_race():
+    jr = mk_ha("fence")
+    lease(jr, 1, t=_stamp(-60))
+    won = ha.fence(jr)
+    assert won == 2
+    rec = [r for r in jr.records()
+           if r.get("event") == ha.TAKEOVER_EVENT][0]
+    assert rec["prev-epoch"] == 1
+    assert rec["prev-writer"] == jr.writer
+    assert rec["prev-lease-t"] and rec["lease-s"] == 5.0
+    # the compare-and-swap guard: we judged epoch 1 expired, but a
+    # rival's takeover landed first -- fencing now would fence the
+    # NEW, live coordinator, so the fence must stand down
+    jr2 = mk_ha("fence2")
+    lease(jr2, 1, writer="coord:1", t=_stamp(-60))
+    jr2.append_event({"event": ha.TAKEOVER_EVENT, "epoch": 2,
+                      "prev-epoch": 1, "prev-writer": "coord:1",
+                      "writer": "rival:9", "t": store.local_time()})
+    assert ha.fence(jr2, expect_epoch=1) is None
+    assert ha.current_epoch(jr2.records()) == 2   # nothing appended
+
+
+def test_double_standby_race_exactly_one_fence_wins():
+    jr = mk_ha("race")
+    lease(jr, 1, writer="coord:1", t=_stamp(-120))
+    a = ha.Standby("race", lease_s=0.1, grace_s=0.05, poll_s=0.01)
+    b = ha.Standby("race", lease_s=0.1, grace_s=0.05, poll_s=0.01)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline \
+            and not (a.poll() == "expired" and b.poll() == "expired"):
+        time.sleep(0.02)
+    assert a.poll() == "expired" and b.poll() == "expired"
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def racer(name, sb):
+        barrier.wait()
+        results[name] = sb.fence()
+
+    ts = [threading.Thread(target=racer, args=("a", a)),
+          threading.Thread(target=racer, args=("b", b))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10)
+    wins = [e for e in results.values() if e is not None]
+    # the journal serialized the race: exactly one standby won, and
+    # the fold agrees with the winner. The loser either appended a
+    # losing takeover record (read before the winner's append landed)
+    # or abandoned pre-append on the compare-and-swap guard (read
+    # after) -- both stand-downs are legal
+    assert len(wins) == 1 and wins[0] == 2
+    assert ha.current_epoch(jr.records()) == 2
+    from jepsen_tpu.analysis.fleetmodel import CampaignModel
+    diags, audited = fleetlint._ha_diags(CampaignModel("race"))
+    assert audited in (1, 2)
+    assert not [d for d in diags if "zombie" in d.message
+                or "split brain" in d.message]
+
+
+# ---------------------------------------------------------------------------
+# the active side: renewals and zombie fencing
+
+
+def test_coordinator_lease_renews_then_refuses_once_fenced():
+    jr = mk_ha("active")
+    jr.epoch = 1
+    fenced_with = []
+    ctl = ha.CoordinatorLease(jr, lease_s=5.0, epoch=1,
+                              on_fenced=fenced_with.append)
+    assert ctl.renew() is True
+    recs = jr.records()
+    grant = [r for r in recs if r.get("event") == ha.LEASE_EVENT][-1]
+    assert grant["epoch"] == 1 and grant["lease-s"] == 5.0
+    assert grant["writer"] == jr.writer
+    assert ctl.fenced() is False
+    # a standby fences us behind our back...
+    jr.append_event({"event": ha.TAKEOVER_EVENT, "epoch": 2,
+                     "prev-epoch": 1, "prev-writer": jr.writer,
+                     "writer": "standby:7", "t": store.local_time()})
+    # ...the cached flag is still stale, the refresh path is not
+    assert ctl.fenced() is False
+    assert ctl.fenced(refresh=True) is True
+    assert ctl.fenced_by == (2, "standby:7")
+    assert fenced_with == [(2, "standby:7")]
+    # a fenced coordinator never appends another renewal
+    n = len(jr.records())
+    assert ctl.renew() is False
+    assert len(jr.records()) == n
+    # and on_fenced fired exactly once even if re-checked
+    assert ctl.fenced(refresh=True) is True
+    assert fenced_with == [(2, "standby:7")]
+
+
+def test_same_epoch_claimed_first_by_a_foreign_writer_fences_us():
+    """The fold is first-claim-wins per epoch: if someone else already
+    holds the epoch we think is ours (a lost resume race), our very
+    first renewal must refuse and flag us fenced."""
+    jr = mk_ha("usurp")
+    jr.epoch = 1
+    lease(jr, 1, writer="other:2")     # they claimed epoch 1 first
+    ctl = ha.CoordinatorLease(jr, lease_s=5.0, epoch=1)
+    assert ctl.renew() is False
+    assert ctl.fenced() is True
+    assert ctl.fenced_by == (1, "other:2")
+    # ...and our refusal appended nothing
+    assert all(r.get("writer") == "other:2" for r in jr.records()
+               if r.get("event") == ha.LEASE_EVENT)
+
+
+# ---------------------------------------------------------------------------
+# the passive side: skew-immune detection
+
+
+def test_standby_never_fences_while_the_journal_grows():
+    """A live coordinator with an hours-BEHIND wall clock writes
+    stale-looking stamps forever; arrivals must protect it."""
+    jr = mk_ha("behind")
+    sb = ha.Standby("behind", lease_s=0.2, grace_s=0.1, poll_s=0.01)
+    for _ in range(4):
+        lease(jr, 1, t=_stamp(-3600), lease_s=0.2)
+        assert sb.poll() is None
+        time.sleep(0.12)
+    # the journal kept growing inside every lease window: no expiry
+    assert sb.poll() is None
+
+
+def test_standby_detects_a_dead_coordinator_with_an_ahead_clock():
+    """A dead coordinator whose stamps run far AHEAD of the standby's
+    clock: the observed future-skew bound credits the offset so the
+    stamp condition cannot mask the death forever."""
+    jr = mk_ha("ahead")
+    lease(jr, 1, t=_stamp(+3600), lease_s=0.2)
+    sb = ha.Standby("ahead", lease_s=0.2, grace_s=0.1, poll_s=0.01)
+    assert sb.poll() is None          # first sight: journal "moved"
+    deadline = time.monotonic() + 10
+    status = None
+    while time.monotonic() < deadline:
+        status = sb.poll()
+        if status == "expired":
+            break
+        time.sleep(0.05)
+    assert status == "expired"
+    # the fence records the skew allowance it credited
+    assert sb.fence() == 2
+    rec = [r for r in jr.records()
+           if r.get("event") == ha.TAKEOVER_EVENT][0]
+    assert rec["skew-allowance-s"] > 3000
+
+
+def test_standby_wait_returns_complete_for_a_finalized_campaign():
+    mk_ha("done", status="complete")
+    sb = ha.Standby("done", lease_s=0.2, grace_s=0.1, poll_s=0.01)
+    assert sb.wait(timeout_s=5) == ("complete", None)
+
+
+def test_standby_wait_times_out_on_a_non_ha_journal():
+    """HA off: no coordinator-lease records, never fenced."""
+    jr = mk_ha("noha")
+    jr.append_event({"event": "lease", "cell": "a", "worker": "w1",
+                     "attempt": 1, "lease-s": 60.0,
+                     "t": _stamp(-3600)})
+    sb = ha.Standby("noha", lease_s=0.1, grace_s=0.05, poll_s=0.01)
+    assert sb.wait(timeout_s=1.0) == ("timeout", None)
+
+
+# ---------------------------------------------------------------------------
+# chaos: the coordinator-kill fault
+
+
+def test_chaos_coordinator_kill_parse_and_deterministic_plan():
+    prof = fchaos.parse("coordinator-kill:7")
+    assert prof.coordinator_kill == 1
+    assert prof.seed == 7
+    ids = [f"c{i}" for i in range(6)]
+    pick = prof.plan_coordinator_kill(ids)
+    assert pick == prof.plan_coordinator_kill(list(reversed(ids)))
+    assert pick in ids
+    # mid-campaign: the first (sorted) cell is skipped given a choice
+    assert pick != sorted(ids)[0]
+    # a one-cell campaign still kills (on the only cell there is)
+    assert prof.plan_coordinator_kill(["solo"]) == "solo"
+    # no-kill profiles plan nothing
+    assert fchaos.parse("flaky-exec:1").plan_coordinator_kill(ids) \
+        is None
+    assert prof.with_seed(8).plan_coordinator_kill(ids) \
+        == prof.with_seed(8).plan_coordinator_kill(ids)
+
+
+# ---------------------------------------------------------------------------
+# FL016: golden journals
+
+
+def _ha_fleet(cid, status="complete"):
+    jr = CampaignJournal(cid)
+    jr.write_meta({"status": status, "mode": "fleet", "cells": ["a"],
+                   "workers": ["w1"], "lease-s": 60.0, "max-leases": 3,
+                   "coordinator-lease-s": 5.0, "ha-epoch": 1})
+    return jr
+
+
+def _cell(jr, cell="a", epoch=1, writer=None, **kw):
+    rec = {"cell": cell, "group": cell, "params": {}, "outcome": True,
+           "valid": True, "worker": "w1", "attempt": 1, "epoch": epoch,
+           **kw}
+    if writer is not None:
+        rec["writer"] = writer
+    jr.append_event({"event": "lease", "cell": cell, "worker": "w1",
+                     "attempt": 1, "lease-s": 60.0, "epoch": epoch,
+                     "t": store.local_time(),
+                     **({"writer": writer} if writer else {})})
+    jr.append_cell(rec)
+
+
+def test_fl016_clean_takeover_chain_passes():
+    jr = _ha_fleet("golden")
+    lease(jr, 1, writer="coord:1", t=_stamp(-60))
+    jr.append_event({"event": ha.TAKEOVER_EVENT, "epoch": 2,
+                     "prev-epoch": 1, "prev-writer": "coord:1",
+                     "reason": "lease-expired", "t": store.local_time(),
+                     "prev-lease-t": _stamp(-60), "lease-s": 5.0})
+    lease(jr, 2)
+    _cell(jr, "a", epoch=2)
+    diags = fleetlint.lint_campaign("golden")
+    assert "FL016" not in _codes(diags)
+
+
+def test_fl016_zombie_append_after_the_fence():
+    jr = _ha_fleet("zombie")
+    lease(jr, 1, writer="coord:1", t=_stamp(-60))
+    jr.append_event({"event": ha.TAKEOVER_EVENT, "epoch": 2,
+                     "prev-epoch": 1, "prev-writer": "coord:1",
+                     "reason": "lease-expired", "t": store.local_time(),
+                     "prev-lease-t": _stamp(-60), "lease-s": 5.0})
+    lease(jr, 2)
+    # the fenced coordinator's late append slips through the race
+    # window: stamped with the PRE-takeover epoch
+    _cell(jr, "a", epoch=1, writer="coord:1")
+    diags = fleetlint.lint_campaign("zombie")
+    zombie = [d for d in diags if d.code == "FL016"
+              and "zombie append" in d.message]
+    assert zombie and zombie[0].severity == ERROR
+
+
+def test_fl016_zombie_renewal_and_split_brain():
+    jr = _ha_fleet("renew")
+    lease(jr, 1, writer="coord:1", t=_stamp(-60))
+    jr.append_event({"event": ha.TAKEOVER_EVENT, "epoch": 2,
+                     "prev-epoch": 1, "prev-writer": "coord:1",
+                     "reason": "lease-expired", "t": store.local_time(),
+                     "prev-lease-t": _stamp(-60), "lease-s": 5.0})
+    lease(jr, 2)
+    lease(jr, 1, writer="coord:1")          # zombie renewal
+    lease(jr, 2, writer="intruder:3")       # split brain on epoch 2
+    msgs = [d.message for d in fleetlint.lint_campaign("renew")
+            if d.code == "FL016" and d.severity == ERROR]
+    assert any("zombie coordinator renewal" in m for m in msgs)
+    assert any("split brain" in m for m in msgs)
+
+
+def test_fl016_premature_takeover_and_self_fence():
+    jr = _ha_fleet("premature")
+    lease(jr, 1, writer="coord:1", t=_stamp(-1))   # renewed 1s ago
+    jr.append_event({"event": ha.TAKEOVER_EVENT, "epoch": 2,
+                     "prev-epoch": 1, "prev-writer": "coord:1",
+                     "reason": "lease-expired", "t": store.local_time(),
+                     "prev-lease-t": _stamp(-1), "lease-s": 5.0,
+                     "writer": "coord:1"})
+    lease(jr, 2, writer="coord:1")
+    _cell(jr, "a", epoch=2, writer="coord:1")
+    msgs = [d.message for d in fleetlint.lint_campaign("premature")
+            if d.code == "FL016" and d.severity == ERROR]
+    assert any("premature takeover" in m for m in msgs)
+    assert any("names ITSELF" in m for m in msgs)
+
+
+def test_fl016_forced_takeover_skips_the_expiry_requirement():
+    jr = _ha_fleet("forced")
+    lease(jr, 1, writer="coord:1", t=_stamp(-1))
+    jr.append_event({"event": ha.TAKEOVER_EVENT, "epoch": 2,
+                     "prev-epoch": 1, "prev-writer": "coord:1",
+                     "reason": "manual-resume", "forced": True,
+                     "t": store.local_time()})
+    lease(jr, 2)
+    _cell(jr, "a", epoch=2)
+    assert not [d for d in fleetlint.lint_campaign("forced")
+                if d.code == "FL016"]
+
+
+def test_fl016_vanished_coordinator_kill_warns():
+    """Chaos scheduled a coordinator-kill but the journal carries no
+    HA events at all: the kill (or the protocol) vanished."""
+    jr = CampaignJournal("vanish")
+    jr.write_meta({"status": "complete", "mode": "fleet",
+                   "cells": ["a"], "workers": ["w1"], "lease-s": 60.0,
+                   "max-leases": 3,
+                   "chaos": fchaos.parse("coordinator-kill:7")
+                   .describe()})
+    _cell(jr, "a", epoch=None)
+    diags = [d for d in fleetlint.lint_campaign("vanish")
+             if d.code == "FL016"]
+    assert diags and diags[0].severity == WARNING
+    assert "vanished" in diags[0].message
+
+
+# ---------------------------------------------------------------------------
+# PL024: the HA knobs
+
+
+def test_pl024_accepts_a_sane_ha_config():
+    assert planlint.lint_ha({"ha?": True, "coordinator-lease-s": 15,
+                             "takeover-grace-s": 5,
+                             "renew-interval-s": 5,
+                             "lease-s": 300}) == []
+    assert planlint.lint_ha({"ha?": False}) == []
+    assert planlint.lint_ha({}) == []
+
+
+def test_pl024_rejects_bad_knob_values():
+    for v in (0, -1, "3", True):
+        diags = planlint.lint_ha({"ha?": True,
+                                  "coordinator-lease-s": v})
+        assert any(d.code == "PL024" and d.severity == ERROR
+                   and d.location == "ha.coordinator-lease-s"
+                   for d in diags), v
+    diags = planlint.lint_ha({"ha?": True, "coordinator-lease-s": 10,
+                              "takeover-grace-s": -2})
+    assert any(d.location == "ha.takeover-grace-s" for d in diags)
+
+
+def test_pl024_self_fencing_renew_interval():
+    diags = planlint.lint_ha({"ha?": True, "coordinator-lease-s": 5,
+                              "renew-interval-s": 5})
+    assert any(d.code == "PL024" and d.severity == ERROR
+               and "renew" in d.message for d in diags)
+
+
+def test_pl024_standby_needs_a_reachable_store():
+    diags = planlint.lint_ha({"ha?": True, "standby?": True,
+                              "store-reachable?": False})
+    assert any(d.code == "PL024" and d.severity == ERROR
+               for d in diags)
+    assert planlint.lint_ha({"ha?": True, "coordinator-lease-s": 5,
+                             "standby?": True,
+                             "store-reachable?": True}) == []
+
+
+def test_pl024_coordinator_kill_without_ha_is_unfenceable():
+    diags = planlint.lint_ha({"ha?": False,
+                              "chaos-coordinator-kill?": True})
+    assert any(d.code == "PL024" and d.severity == ERROR
+               for d in diags)
+    assert planlint.lint_ha({"ha?": True, "coordinator-lease-s": 5,
+                             "chaos-coordinator-kill?": True}) == []
+
+
+def test_pl024_warns_when_coordinator_ttl_exceeds_cell_lease():
+    diags = planlint.lint_ha({"ha?": True, "coordinator-lease-s": 600,
+                              "lease-s": 60})
+    assert any(d.code == "PL024" and d.severity == WARNING
+               for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# satellite: torn-rewrite regression (fsync before rename) + the
+# scheduler's HA-resume refusal
+
+
+def test_campaign_meta_rewrite_fsyncs_before_rename(monkeypatch):
+    """campaign.json is rewritten in place on every status change: the
+    temp file's data blocks must hit disk BEFORE os.replace publishes
+    the name, or a power cut can publish a stale-but-valid meta."""
+    calls = []
+    real_fsync, real_replace = os.fsync, os.replace
+
+    def spy_fsync(fd):
+        calls.append(("fsync",))
+        return real_fsync(fd)
+
+    def spy_replace(src, dst):
+        calls.append(("replace", os.path.basename(dst)))
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    monkeypatch.setattr(os, "replace", spy_replace)
+    jr = CampaignJournal("torn")
+    jr.write_meta({"status": "running", "mode": "fleet"})
+    upto = {i for i, c in enumerate(calls)
+            if c == ("replace", "campaign.json")}
+    assert upto, calls
+    # at least one fsync strictly precedes the publishing rename
+    assert any(("fsync",) in calls[:i] for i in upto), calls
+    # and the rewrite really is atomic: no torn half-file on disk
+    meta = json.load(open(store.campaign_path("torn", "campaign.json")))
+    assert meta["status"] == "running"
+
+
+def test_scheduler_refuses_to_resume_an_ha_journal():
+    jr = mk_ha("hares")
+    lease(jr, 1)
+    cells = plan.expand({"axes": {"workload": ["noop"], "seed": [0]}})
+    with pytest.raises(scheduler.CampaignError, match="coordinator-HA"):
+        scheduler.run_cells(cells, campaign_id="hares", resume=True)
+
+
+def test_scheduler_resume_preserves_prior_meta_keys():
+    """A resume's meta rewrite must not strip keys a prior (possibly
+    newer) coordinator recorded alongside the scheduler's own."""
+    from jepsen_tpu import checker as cc
+    from jepsen_tpu import client as jc
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import tests as tst
+
+    class OkClient(jc.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            return dict(op, type="ok")
+
+    t = tst.noop_test()
+    t.update(ssh={"dummy?": True}, name="keep-cell", nodes=["n1"],
+             concurrency=1, client=OkClient(), checker=cc.noop(),
+             generator=gen.clients(
+                 gen.limit(2, gen.repeat({"f": "read"}))))
+    t["obs?"] = False
+    cells = [{"id": "a", "test": t}]
+    scheduler.run_cells(cells, campaign_id="keep", fleetlint=False,
+                        certify=False, ledger=False)
+    jr = CampaignJournal("keep")
+    meta = jr.load_meta()
+    meta["extra-key"] = "survives"
+    jr.write_meta(meta)
+    rep = scheduler.run_cells(cells, campaign_id="keep", resume=True,
+                              fleetlint=False, certify=False,
+                              ledger=False)
+    assert rep["status"] == "complete"
+    meta = jr.load_meta()
+    assert meta["status"] == "complete"
+    assert meta["extra-key"] == "survives"
+    assert meta["resumes"] == 1
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance run: kill the coordinator, let a standby finish
+
+
+NOOP_OPTS = {"nodes": ["n1"], "concurrency": 1, "ssh": {"dummy?": True},
+             "time-limit": 1, "workload": "noop"}
+
+_COORD_SCRIPT = """
+import sys
+from jepsen_tpu import store
+store.base_dir = sys.argv[1]
+from jepsen_tpu.campaign import plan
+from jepsen_tpu.fleet import chaos, dispatch
+cells = plan.expand({"axes": {"workload": ["noop"], "seed": [0, 1]}})
+dispatch.run_fleet(
+    cells, dispatch.parse_workers("local,local"),
+    campaign_id="ha-kill", builder="jepsen_tpu.demo:demo_test",
+    base_options=%r, lease_s=300, max_leases=5,
+    coordinator_lease_s=1.0, takeover_grace_s=0.5,
+    chaos=chaos.parse("coordinator-kill:7"))
+""" % (NOOP_OPTS,)
+
+
+def test_ha_takeover_e2e_coordinator_kill_standby_finishes(tmp_path):
+    """SIGKILL the live coordinator right after a seeded lease-grant
+    append; a standby detects the dead lease, fences it with a
+    journaled takeover, resumes the campaign, and finishes with
+    exactly one terminal record per cell and a ZERO-error,
+    ZERO-warning fleetlint audit (FL004/FL007/FL016)."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.pathsep.join(
+               [os.path.dirname(os.path.dirname(os.path.abspath(
+                   __file__)))] + sys.path)}
+    proc = subprocess.run(
+        [sys.executable, "-c", _COORD_SCRIPT, store.base_dir],
+        capture_output=True, text=True, timeout=300, env=env)
+    # the chaos fault really SIGKILLed the coordinator mid-campaign
+    assert proc.returncode == -signal.SIGKILL, \
+        (proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:])
+    assert os.path.exists(ha.takeover_marker("ha-kill"))
+    recs = store.load_campaign_records("ha-kill")
+    assert ha.current_epoch(recs) == 1
+    meta = CampaignJournal("ha-kill").load_meta()
+    assert meta["status"] == "running"          # died mid-flight
+    assert meta["coordinator-lease-s"] == 1.0
+    assert meta["ha-epoch"] == 1
+
+    # the standby tails, detects expiry, fences
+    sb = ha.Standby("ha-kill", poll_s=0.05)
+    status, epoch = sb.wait(timeout_s=120)
+    assert (status, epoch) == ("takeover", 2)
+    # ...and resumes through the fleet path under the won epoch
+    rep = dispatch.run_fleet(
+        plan.expand({"axes": {"workload": ["noop"], "seed": [0, 1]}}),
+        dispatch.parse_workers("local,local"),
+        campaign_id="ha-kill", resume=True, ha_epoch=epoch,
+        builder="jepsen_tpu.demo:demo_test", base_options=NOOP_OPTS,
+        lease_s=300, max_leases=5,
+        coordinator_lease_s=1.0, takeover_grace_s=0.5)
+    assert rep["status"] == "complete"
+
+    recs = store.load_campaign_records("ha-kill")
+    terminal = {}
+    for r in recs:
+        if not r.get("event"):
+            terminal[r["cell"]] = terminal.get(r["cell"], 0) + 1
+    assert terminal == {"noop seed=0": 1, "noop seed=1": 1} \
+        or (len(terminal) == 2 and set(terminal.values()) == {1})
+    # exactly one takeover, naming the dead epoch under a new writer
+    takeovers = [r for r in recs if r.get("event") == ha.TAKEOVER_EVENT]
+    assert len(takeovers) == 1
+    assert takeovers[0]["epoch"] == 2
+    assert takeovers[0]["prev-epoch"] == 1
+    assert takeovers[0]["writer"] != takeovers[0]["prev-writer"]
+    # every post-takeover record is epoch-2 stamped: no zombies
+    seen_takeover = False
+    for r in recs:
+        if r.get("event") == ha.TAKEOVER_EVENT:
+            seen_takeover = True
+        elif seen_takeover and r.get("epoch") is not None:
+            assert r["epoch"] == 2, r
+    # the audit is the oracle: zero errors AND zero warnings
+    fa = rep["fleet_analysis"]
+    assert fa["counts"]["error"] == 0, fa
+    assert fa["counts"]["warning"] == 0, fa
+    assert fa["checks"]["ha_takeovers_audited"] == 1, fa
+    assert fleetlint.load_report("ha-kill")["counts"] == fa["counts"]

@@ -315,15 +315,28 @@ def test_note_request_counts_errors_too():
 
 
 def test_api_request_accounting_over_a_socket(token_server):
+    # note_request runs in the handler's finally AFTER the response is
+    # flushed, so the client can observe its body before the server
+    # thread has counted it -- poll briefly instead of asserting the
+    # instantaneous value
+    def counted(status, want):
+        reg = service.slo_registry()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            got = reg.counter_value("service.requests",
+                                    endpoint="metrics", status=status)
+            if got >= want:
+                return got
+            time.sleep(0.01)
+        return reg.counter_value("service.requests",
+                                 endpoint="metrics", status=status)
+
     _get(token_server, "/api/metrics", token="sekrit")
     _get(token_server, "/api/metrics", token="sekrit")
-    reg = service.slo_registry()
-    assert reg.counter_value("service.requests", endpoint="metrics",
-                             status="200") >= 2
+    assert counted("200", 2) >= 2
     # a 401 is accounted too
     _get(token_server, "/api/metrics")
-    assert reg.counter_value("service.requests", endpoint="metrics",
-                             status="401") >= 1
+    assert counted("401", 1) >= 1
 
 
 # ---------------------------------------------------------------------------

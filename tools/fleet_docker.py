@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Bootstrap and exercise a real-SSH worker fleet in local docker
+containers (docker/compose.yml: two sshd nodes with the repo
+bind-mounted read-only at /repo).
+
+Usage::
+
+    python tools/fleet_docker.py up       # keygen + build + wait for sshd
+    python tools/fleet_docker.py run      # campaign across both nodes
+    python tools/fleet_docker.py workers  # print the --workers spec
+    python tools/fleet_docker.py down     # tear the fleet down
+
+Exit codes: 0 success, 1 the step failed (campaign incomplete, a cell
+without a true outcome, unsynced artifacts, fleetlint errors), 2
+docker/compose unavailable.
+
+``run`` goes through ``fleet.dispatch.run_fleet`` directly (not the
+CLI) because the workers' repo lives at a DIFFERENT path than the
+coordinator's (/repo in-container vs the checkout on the host), so
+the dispatcher needs explicit ``cwd="/repo"`` / ``python="python3"``.
+Everything else is the stock fleet path: leases journaled to
+cells.jsonl, results over stdin/stdout, artifact sync over real scp
+with manifest verification, clock skew normalized from the lease
+handshake stamps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DOCKER_DIR = os.path.join(REPO, "docker")
+KEYS_DIR = os.path.join(DOCKER_DIR, ".keys")
+PRIVATE_KEY = os.path.join(KEYS_DIR, "id_ed25519")
+
+#: (worker id, mapped loopback port) for each compose service
+NODES = (("node1", 2221), ("node2", 2222))
+
+#: where each worker container writes its runs (its OWN filesystem:
+#: artifact sync must move bytes over scp to get them home)
+WORKER_STORE = "/tmp/jepsen-fleet-store"
+
+
+def workers_spec():
+    """The ``--workers`` string for the compose fleet."""
+    return ",".join(f"{wid}=127.0.0.1:{port}" for wid, port in NODES)
+
+
+def ssh_spec():
+    """The conn-spec mapping ``dispatch.parse_workers`` consumes."""
+    return {"username": "root", "private-key-path": PRIVATE_KEY,
+            "strict-host-key-checking": False}
+
+
+def compose_argv():
+    """A usable `docker compose` invocation, or None."""
+    exe = shutil.which("docker")
+    if exe is None:
+        return None
+    probe = subprocess.run([exe, "compose", "version"],
+                           capture_output=True, text=True)
+    if probe.returncode == 0:
+        return [exe, "compose"]
+    legacy = shutil.which("docker-compose")
+    return [legacy] if legacy else None
+
+
+def compose(args, check=True):
+    argv = compose_argv()
+    if argv is None:
+        print("fleet_docker: docker compose is not available", flush=True)
+        sys.exit(2)
+    return subprocess.run(argv + ["-f",
+                                  os.path.join(DOCKER_DIR, "compose.yml")]
+                          + args, check=check)
+
+
+def ensure_keys():
+    """Generate the fleet keypair once (docker/.keys/, gitignored)."""
+    if os.path.exists(PRIVATE_KEY):
+        return
+    os.makedirs(KEYS_DIR, exist_ok=True)
+    subprocess.run(["ssh-keygen", "-t", "ed25519", "-N", "", "-q",
+                    "-C", "jepsen-fleet", "-f", PRIVATE_KEY], check=True)
+    print(f"fleet_docker: generated {PRIVATE_KEY}", flush=True)
+
+
+def wait_for_sshd(timeout_s=120.0):
+    """Poll ``ssh ... true`` on every node until the fleet answers."""
+    pending = dict(NODES)
+    deadline = time.monotonic() + timeout_s
+    while pending and time.monotonic() < deadline:
+        for wid, port in list(pending.items()):
+            res = subprocess.run(
+                ["ssh", "-o", "BatchMode=yes",
+                 "-o", "StrictHostKeyChecking=no",
+                 "-o", "UserKnownHostsFile=/dev/null",
+                 "-o", "ConnectTimeout=3",
+                 "-p", str(port), "-i", PRIVATE_KEY,
+                 "root@127.0.0.1", "true"],
+                capture_output=True, text=True)
+            if res.returncode == 0:
+                print(f"fleet_docker: {wid} (port {port}) is up",
+                      flush=True)
+                del pending[wid]
+        if pending:
+            time.sleep(2)
+    if pending:
+        print(f"fleet_docker: sshd never answered on {sorted(pending)}",
+              flush=True)
+        return False
+    return True
+
+
+def up():
+    ensure_keys()
+    compose(["up", "-d", "--build"])
+    return 0 if wait_for_sshd() else 1
+
+
+def down():
+    compose(["down", "--volumes", "--remove-orphans"])
+    return 0
+
+
+def run_campaign(campaign_id="docker-fleet", time_limit=2):
+    """A 2x2 register campaign across the container fleet, asserting
+    the remote path end to end: completion, outcomes, synced +
+    manifest-verified artifacts, clean fleetlint audit."""
+    from jepsen_tpu import campaign, store
+    from jepsen_tpu.fleet import dispatch
+
+    cells = campaign.plan.expand(
+        {"axes": {"workload": ["register"], "seed": [0, 1]}})
+    workers = dispatch.parse_workers(workers_spec(), ssh=ssh_spec())
+    base = {"nodes": ["n1"], "concurrency": 2,
+            "ssh": {"dummy?": True},       # in-worker DB nodes stay dummy
+            "time-limit": time_limit, "workload": "register"}
+    report = dispatch.run_fleet(
+        cells, workers, campaign_id=campaign_id,
+        builder="jepsen_tpu.demo:demo_test", base_options=base,
+        python="python3", cwd="/repo",
+        env={"JAX_PLATFORMS": "cpu"},
+        worker_store_dir=WORKER_STORE,
+        lease_s=300, sync_timeout_s=120)
+
+    failures = []
+    meta = json.load(open(store.campaign_path(campaign_id,
+                                              "campaign.json")))
+    if meta.get("status") != "complete":
+        failures.append(f"campaign status {meta.get('status')!r}")
+    recs = {str(r.get("cell")): r
+            for r in store.latest_campaign_records(campaign_id)}
+    for c in cells:
+        rec = recs.get(c["id"])
+        if rec is None or rec.get("outcome") is not True:
+            failures.append(f"cell {c['id']}: outcome "
+                            f"{(rec or {}).get('outcome')!r}")
+        elif not rec.get("synced"):
+            failures.append(f"cell {c['id']}: artifacts not synced "
+                            f"({rec.get('sync-error')})")
+        elif rec.get("path") and not os.path.isdir(str(rec["path"])):
+            failures.append(f"cell {c['id']}: synced run dir missing "
+                            f"{rec['path']}")
+    fa_path = store.campaign_path(campaign_id, "fleet_analysis.json")
+    try:
+        fa = json.load(open(fa_path))
+        counts = fa.get("counts") or {}
+        if counts.get("error"):
+            failures.append(f"fleetlint: {counts['error']} error(s)")
+    except (OSError, json.JSONDecodeError) as e:
+        failures.append(f"no readable fleet_analysis.json: {e}")
+    print(f"fleet_docker: campaign {campaign_id}: "
+          f"{len(report.get('results') or [])} results, "
+          f"{len(failures)} failure(s)", flush=True)
+    for f in failures:
+        print(f"fleet_docker: FAIL {f}", flush=True)
+    return 1 if failures else 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="tools/fleet_docker.py")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("up", help="keygen + compose up + wait for sshd")
+    sub.add_parser("down", help="compose down")
+    sub.add_parser("workers", help="print the --workers spec")
+    runp = sub.add_parser("run", help="campaign across the fleet")
+    runp.add_argument("--campaign-id", default="docker-fleet")
+    runp.add_argument("--time-limit", type=int, default=2)
+    ns = p.parse_args(argv)
+    if ns.cmd == "up":
+        return up()
+    if ns.cmd == "down":
+        return down()
+    if ns.cmd == "workers":
+        print(workers_spec())
+        return 0
+    return run_campaign(campaign_id=ns.campaign_id,
+                        time_limit=ns.time_limit)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -982,6 +982,165 @@ def _service_throughput_rung(clients=8, per_client=3, bursts=10):
         return {"error": repr(exc)[:300]}
 
 
+def _txn_scale_rung(n_txns=16384, appends_per_txn=7, chunk=1024,
+                    budget_s=900):
+    """Transactional cycle checking at scale (rung 15): a serial
+    list-append history of >= 1e5 micro-ops checked two ways -- one
+    offline ``cycle/`` analysis of the whole history (the
+    cycle-checked txns/s headline) and the family="txn" monitor core
+    driven chunk by chunk (per-chunk latency plus the squaring-pass
+    ledger against the from-scratch closure every chunk would
+    otherwise pay -- the incrementality contract, measured).
+
+    This is the scale/family the WGL engine is refused at outright:
+    multi-key txn micro-ops have no sequential model, so the rung
+    records the model registry's refusal verbatim instead of timing a
+    search that cannot exist. The duty cycle comes from the
+    ``txn.closure_busy_s`` counter the closure kernels bracket (the
+    same metrics plane as ``wgl.device_busy_s``), over each mode's
+    wall. Self-contained and never fatal."""
+    import numpy as _np
+
+    try:
+        from jepsen_tpu import cycle, obs
+        from jepsen_tpu.monitor import engine as mengine
+        from jepsen_tpu.monitor.txn import TxnCheck
+
+        # serial multi-key history: each txn reads its key's committed
+        # prefix THEN appends (the read stays cross-txn: observing your
+        # own in-txn appends is legal but exercises nothing), keys
+        # retire after txns_per_key txns so reads stay short
+        txns_per_key = 8
+        events = []
+        t = 0
+        for i in range(n_txns):
+            k = f"k{i // txns_per_key}"
+            base = (i % txns_per_key) * appends_per_txn
+            mops = ([["r", k, None]]
+                    + [["append", k, base + j + 1]
+                       for j in range(appends_per_txn)])
+            done = [list(m) for m in mops]
+            done[0] = ["r", k, list(range(1, base + 1))]
+            events.append({"type": "invoke", "f": "txn",
+                           "process": i % 8, "time": t, "value": mops})
+            events.append({"type": "ok", "f": "txn",
+                           "process": i % 8, "time": t + 1,
+                           "value": done})
+            t += 2
+        micro_ops = n_txns * (appends_per_txn + 1)
+        out = {"txns": n_txns, "micro_ops": micro_ops,
+               "events": len(events), "chunk": chunk}
+
+        # the WGL side of the fork in the road: no sequential model
+        # exists for multi-key txn micro-ops, so the linearizability
+        # path refuses at the registry, before any search
+        try:
+            from jepsen_tpu.models import model_spec
+            model_spec("txn-append")
+            out["wgl_refusal"] = None
+        except KeyError as exc:
+            out["wgl_refusal"] = str(exc)[:160]
+
+        def busy():
+            reg = obs.registry()
+            if reg is None:
+                return 0.0
+            return sum(v for key, v in
+                       reg.snapshot()["counters"].items()
+                       if key.startswith("txn.closure_busy_s"))
+
+        # OFFLINE: one full analysis -- the txns/s headline
+        b0, p0 = busy(), cycle.closure_passes()
+        t0 = time.monotonic()
+        res = mengine.check_txn_prefix(events, "append")
+        off_wall = time.monotonic() - t0
+        off_busy = busy() - b0
+        out["offline"] = {
+            "valid": res.get("valid"),
+            "wall_s": round(off_wall, 3),
+            "txns_per_s": round(n_txns / off_wall, 1)
+            if off_wall else None,
+            "micro_ops_per_s": round(micro_ops / off_wall, 1)
+            if off_wall else None,
+            "closure_passes": cycle.closure_passes() - p0,
+            "device_busy_s": round(off_busy, 3),
+            "duty_cycle": round(off_busy / off_wall, 4)
+            if off_wall else None,
+        }
+
+        # STREAMING: the monitor core, chunk txns at a time, frontier
+        # resident across chunks
+        core = TxnCheck(workload="append")
+        lat = []
+        b0, p0 = busy(), cycle.closure_passes()
+        t0 = time.monotonic()
+        exhausted = False
+        for start in range(0, len(events), 2 * chunk):
+            for ev in events[start:start + 2 * chunk]:
+                core.offer(ev)
+            c0 = time.monotonic()
+            r = core.check()
+            lat.append(time.monotonic() - c0)
+            if r.get("valid") is not True:
+                out["streaming_error"] = {
+                    "valid": r.get("valid"),
+                    "anomaly_types": r.get("anomaly_types")}
+                break
+            if time.monotonic() - t0 > budget_s:
+                exhausted = True
+                break
+        inc_wall = time.monotonic() - t0
+        inc_passes = cycle.closure_passes() - p0
+        inc_busy = busy() - b0
+        lat_s = sorted(lat)
+        out["streaming"] = {
+            "chunks": len(lat),
+            "txns_checked": core.n_txns,
+            "wall_s": round(inc_wall, 3),
+            "budget_exhausted": exhausted,
+            "chunk_p50_ms": round(lat_s[len(lat_s) // 2] * 1e3, 1)
+            if lat_s else None,
+            "chunk_max_ms": round(lat_s[-1] * 1e3, 1)
+            if lat_s else None,
+            "closure_passes": inc_passes,
+            "closure_rebuilds": core.frontier.rebuilds,
+            "device_busy_s": round(inc_busy, 3),
+            "duty_cycle": round(inc_busy / inc_wall, 4)
+            if inc_wall else None,
+        }
+
+        # the counterfactual: one from-scratch closure at the final
+        # padded size, timed once -- what EVERY chunk would pay
+        # without the resident frontier
+        n_pad = max(64, int(core.frontier.n_pad))
+        scratch_steps = max(1, int(_np.ceil(_np.log2(max(2, n_pad)))))
+        adj = core.frontier._adj[:core.frontier.n, :core.frontier.n]
+        p1 = cycle.closure_passes()
+        s0 = time.monotonic()
+        cycle.transitive_closure(adj)
+        scratch_wall = time.monotonic() - s0
+        out["scratch"] = {
+            "n_pad": n_pad,
+            "closure_s": round(scratch_wall, 3),
+            "closure_passes": cycle.closure_passes() - p1,
+            "per_chunk_passes_if_rebuilt": scratch_steps,
+            "total_passes_if_rebuilt": scratch_steps * len(lat),
+        }
+        if inc_passes:
+            out["passes_saved_x"] = round(
+                scratch_steps * len(lat) / inc_passes, 2)
+        out["goal"] = ("valid at >= 1e5 micro-ops; incremental passes "
+                       "< per-chunk from-scratch total")
+        out["goal_met"] = bool(
+            not exhausted
+            and out["offline"]["valid"] is True
+            and "streaming_error" not in out
+            and inc_passes < scratch_steps * max(1, len(lat)))
+        return out
+    except Exception as exc:  # noqa: BLE001 - numbers, not crashes
+        return {"error": repr(exc)[:300]}
+
+
 def _error_headline(msg):
     """The zero-value headline shape every bench failure path emits
     (one definition so error lines can't drift from success lines)."""
@@ -1491,6 +1650,14 @@ def _bench_body(_obs_reg):
     # detection+takeover latency, re-leased vs lost cells, and the
     # kill-soak wall against the clean HA wall (rung 10's matrix)
     rungs["14-ha-takeover"] = _ha_takeover_rung()
+
+    # txn-scale rung: the transactional family at the scale WGL is
+    # refused at — cycle-checked txns/s over >= 1e5 micro-ops offline,
+    # then the streaming monitor core over the same history: per-chunk
+    # latency and the squaring-pass ledger vs the from-scratch closure
+    # every chunk would otherwise pay, duty cycle from the
+    # closure-busy counter
+    rungs["15-txn-scale"] = _txn_scale_rung()
 
     # CPU oracles race in parallel subprocesses AFTER all device
     # measurements (their CPU load would pollute the device numbers);

@@ -18,9 +18,16 @@ def _add_demo_opts(parser):
                         choices=sorted(demo.WORKLOADS),
                         help="Which demo workload to run.")
     parser.add_argument("--bug", default=None,
-                        choices=["lost-write", "dirty-read"],
+                        choices=["lost-write", "dirty-read",
+                                 "stale-read", "future-read"],
                         help="Inject a bug into the demo client so "
-                             "checkers catch it.")
+                             "checkers catch it (future-read / "
+                             "stale-read target the txn workloads).")
+    parser.add_argument("--nemesis", default=None,
+                        choices=["none", "faketime", "charybdefs"],
+                        help="Nemesis axis for the txn workloads "
+                             "(faketime skews node clocks; charybdefs "
+                             "degrades the filesystem).")
     parser.add_argument("--algorithm", default="jax-wgl",
                         help="Linearizability engine (wgl, jax-wgl, "
                              "competition).")

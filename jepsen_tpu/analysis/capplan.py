@@ -178,7 +178,35 @@ def _register_shapes(params):
 register_shapes("noop", lambda params: [])
 register_shapes("bank", lambda params: [])      # host-side bank fold
 register_shapes("set", lambda params: [])       # host-side set checker
-register_shapes("append", lambda params: [])    # cycle engine, no WGL
+
+
+def _txn_shapes(params):
+    """The transactional family (list-append / rw-register): the device
+    work is the cycle-closure probe, keyed by pow-2 txn-count buckets
+    (``sizemodel.closure_shape``). The txn count is generator-bound:
+    ``txn-count`` pins it; otherwise it derives from
+    time-limit x rate x concurrency (the suite's generator shape), and
+    with neither the cell is an UnknownShape."""
+    n = params.get("txn-count")
+    if n is None:
+        tl = params.get("time-limit")
+        rate = params.get("rate", 100)
+        conc = _concurrency_of(params) or 1
+        if isinstance(tl, (int, float)) and not isinstance(tl, bool) \
+                and tl > 0 and isinstance(rate, (int, float)) \
+                and not isinstance(rate, bool) and rate > 0:
+            n = int(math.ceil(GENERATOR_SLACK * float(tl)
+                              * float(rate) * conc))
+    if not isinstance(n, (int, float)) or isinstance(n, bool) or n <= 0:
+        raise UnknownShape(
+            "txn count is runtime-bound: set txn-count, or time-limit "
+            "+ rate so it can be derived")
+    return [{"model": "txn-closure", "n_ops": int(n),
+             "engine": "txn-closure"}]
+
+
+register_shapes("append", _txn_shapes)
+register_shapes("wr", _txn_shapes)
 
 
 def shapes_for_cell(params):
@@ -194,11 +222,16 @@ def shapes_for_cell(params):
     out = []
     for raw in fn(dict(params)):
         try:
-            out.append(sizemodel.search_shape(
-                raw["model"], raw["n_ops"],
-                keys=int(raw.get("keys") or 1),
-                concurrency=conc,
-                engine=raw.get("engine", "jax-wgl-batch")))
+            if raw.get("engine") == "txn-closure":
+                # the cycle probe has no ModelSpec; its size model is
+                # the closure frontier, not a WGL search plan
+                out.append(sizemodel.closure_shape(raw["n_ops"]))
+            else:
+                out.append(sizemodel.search_shape(
+                    raw["model"], raw["n_ops"],
+                    keys=int(raw.get("keys") or 1),
+                    concurrency=conc,
+                    engine=raw.get("engine", "jax-wgl-batch")))
         except (KeyError, TypeError, ValueError) as e:
             raise UnknownShape(
                 f"workload {w!r}: {e!r}") from None

@@ -59,6 +59,9 @@ Code catalogue (doc/analysis.md):
   VC011 info   differential sample undecided / partial coverage
   VC012 error  persisted certificate disagrees with the run's
                artifacts, or is unreadable
+  VC013 error  cycle witness does not replay through host-side
+               dependency inference (txn family: missing edge, wrong
+               edge type, or class/edge-composition mismatch)
 """
 
 from __future__ import annotations
@@ -524,6 +527,189 @@ def certify_with_diagnostics(spec, client_hist, result, test=None,
 
 
 # ---------------------------------------------------------------------------
+# cycle-family (txn) witnesses: replay the implicated cycle host-side
+
+#: per-class edge-composition rules (base names; -realtime/-process
+#: variants additionally require >=1 edge of the extending type)
+_CYCLE_RULES = {
+    "G0": {"allowed": {"ww"}, "rw": (0, 0)},
+    "G1c": {"allowed": {"ww", "wr"}, "require": "wr", "rw": (0, 0)},
+    "G-single": {"allowed": {"ww", "wr", "rw"}, "rw": (1, 1)},
+    "G2": {"allowed": {"ww", "wr", "rw"}, "rw": (2, None)},
+}
+
+
+def _txn_graph(history, workload, opts):
+    """Re-infer the dependency graph the verdict claims to come from."""
+    from ..cycle import DEFAULT_ANOMALIES
+    opts = dict(opts or {})
+    if workload == "wr":
+        from ..cycle import wr as engine
+        graph, _found, oks, _garbage = engine.infer(list(history), opts)
+        return graph, oks
+    from ..cycle import append as engine
+    graph, _found, oks = engine.infer(
+        list(history),
+        tuple(opts.get("anomalies", DEFAULT_ANOMALIES)),
+        opts.get("realtime", True), opts.get("process", False),
+        opts.get("skew-bound", opts.get("skew_bound", 0)))
+    return graph, oks
+
+
+def certify_cycle_witness(result, history, workload="append", opts=None,
+                          checks=None):
+    """Certify a cycle-family (txn) verdict's witnesses: re-run the
+    host-side dependency inference over the history and replay every
+    implicated cycle through the re-inferred graph -- each claimed edge
+    must exist with its claimed type bits, and the cycle's edge
+    composition must match its anomaly class (G0 ww-only, G1c >=1 wr,
+    G-single exactly 1 rw, G2 >=2 rw; *-realtime/-process need an edge
+    of the extending type). Any mismatch is VC013. Returns
+    diagnostics; appends per-witness check records."""
+    diags = []
+    checks = checks if checks is not None else []
+    anomalies = (result or {}).get("anomalies")
+    wits = [(cls, w) for cls, ws in (anomalies or {}).items()
+            if isinstance(ws, list)
+            for w in ws
+            if isinstance(w, dict) and isinstance(w.get("steps"), list)]
+    if not wits:
+        checks.append({"name": "cycle-witness", "status": "skipped",
+                       "detail": "no cycle witnesses in the result"})
+        return diags
+    try:
+        graph, oks = _txn_graph(history, workload, opts)
+    except Exception as exc:  # noqa: BLE001 - reported, never raised
+        diags.append(diag(
+            "VC013", ERROR,
+            f"cycle-witness replay inference crashed: {exc!r}",
+            location="certificate.cycle_witness",
+            fix_hint="the history artifact no longer matches the "
+                     "verdict; re-run the offline checker"))
+        checks.append({"name": "cycle-witness", "status": "failed",
+                       "detail": repr(exc)})
+        return diags
+    for cls, w in wits:
+        loc = f"certificate.cycle_witness[{cls}]"
+        problems = []
+        base = cls.replace("-realtime", "").replace("-process", "")
+        rule = _CYCLE_RULES.get(base)
+        seen_types = set()
+        rw_edges = 0
+        for step in w["steps"]:
+            a, b = step.get("from"), step.get("to")
+            claimed = set(str(step.get("type", "")).split("+")) - {""}
+            if not (isinstance(a, int) and isinstance(b, int)
+                    and 0 <= a < graph.n and 0 <= b < graph.n):
+                problems.append(f"edge {a}->{b} indexes outside the "
+                                f"{graph.n}-txn graph")
+                continue
+            from ..cycle import edge_name
+            actual = set(edge_name(int(graph.adj[a, b])).split("+"))
+            if int(graph.adj[a, b]) == 0 or not claimed <= actual:
+                problems.append(
+                    f"edge {a}->{b} claimed {'+'.join(sorted(claimed))}"
+                    f" but re-inference found "
+                    f"{'+'.join(sorted(actual)) if graph.adj[a, b] else 'no edge'}")
+                continue
+            seen_types |= claimed
+            if "rw" in claimed:
+                rw_edges += 1
+        if rule is not None and not problems:
+            lo, hi = rule["rw"]
+            if base in ("G0", "G1c") \
+                    and not seen_types <= (rule["allowed"]
+                                           | {"rt", "process"}):
+                problems.append(
+                    f"{cls}: cycle uses edge types "
+                    f"{sorted(seen_types)} outside the class")
+            if rule.get("require") and rule["require"] not in seen_types:
+                problems.append(f"{cls}: no {rule['require']} edge in "
+                                "the witness")
+            if rw_edges < lo or (hi is not None and rw_edges > hi):
+                problems.append(f"{cls}: witness has {rw_edges} rw "
+                                f"edge(s), class requires "
+                                f"[{lo}, {hi if hi is not None else 'inf'}]")
+            if cls.endswith("-realtime") and "rt" not in seen_types:
+                problems.append(f"{cls}: no rt edge in the witness")
+            if cls.endswith("-process") and "process" not in seen_types:
+                problems.append(f"{cls}: no process edge in the witness")
+        if problems:
+            diags.append(diag(
+                "VC013", ERROR,
+                f"cycle witness for {cls} does not replay: "
+                + "; ".join(problems),
+                location=loc,
+                fix_hint="the verdict's witness disagrees with "
+                         "host-side re-inference over the same "
+                         "history; treat the verdict as suspect"))
+            checks.append({"name": "cycle-witness", "class": cls,
+                           "status": "failed",
+                           "detail": "; ".join(problems)})
+        else:
+            checks.append({"name": "cycle-witness", "class": cls,
+                           "status": "confirmed",
+                           "edges": len(w["steps"])})
+    return diags
+
+
+def certify_txn_verdict(test, hist, result, workload="append",
+                        opts=None):
+    """In-run hook for cycle-family verdicts (the FnChecker wrapper in
+    tests/cycle calls it after analysis): replay every cycle witness
+    host-side, land findings in ``test["analysis"]["certify"]`` and
+    the proof in ``test["certificate"]`` (persisted as
+    certificate.json). Contained exactly like certify_verdict: a
+    certifier bug must NEVER flip a verdict or exit code."""
+    if not isinstance(test, dict) or not isinstance(result, dict) \
+            or result.get("valid") not in (True, False):
+        return
+    try:
+        if not enabled(test):
+            return
+        if test.get("certify-done?"):
+            return
+        test["certify-done?"] = True
+        from .. import analysis
+        checks = []
+
+        def build():
+            return certify_cycle_witness(result, hist, workload, opts,
+                                         checks=checks)
+
+        diags = analysis.run_analyzer("certify-txn", build)
+        rep = to_json(diags)
+        cert = {"schema": SCHEMA,
+                "family": "txn",
+                "model": f"txn-{workload}",
+                "engine": f"txn-{workload}",
+                "verdict": result.get("valid"),
+                "anomaly_types": list(result.get("anomaly_types")
+                                      or ()),
+                "context": {"workload": workload,
+                            "opts": dict(opts or {})},
+                "checks": checks,
+                "diagnostics": rep["diagnostics"],
+                "counts": rep["counts"]}
+        report = to_json(diags)
+        report["summary"] = {"verdict": cert["verdict"],
+                             "engine": cert["engine"],
+                             "checks": checks}
+        test.setdefault("analysis", {})["certify"] = report
+        test["certificate"] = cert
+        errs = analysis.errors(diags)
+        if errs:
+            logger.warning(
+                "%s", analysis.render_text(
+                    errs, title="cycle-witness certification FAILED; "
+                                "the verdict above does not replay "
+                                "from its own witness:"))
+    except Exception:  # noqa: BLE001 - contained, never verdict-bearing
+        logger.warning("txn verdict certification crashed",
+                       exc_info=True)
+
+
+# ---------------------------------------------------------------------------
 # monitor backstop: certify a violation's parked evidence
 
 def certify_monitor(evidence, budget=DEFAULT_BUDGET):
@@ -534,7 +720,25 @@ def certify_monitor(evidence, budget=DEFAULT_BUDGET):
     backstop the ``skip-offline?`` handoff never had -- the monitor's
     word becomes the verdict of record there, so its False must be
     independently confirmable. Returns ``(summary, diagnostics)``;
-    the summary is JSON-able."""
+    the summary is JSON-able. Txn-family evidence (the streaming cycle
+    monitor) replays the implicated cycle host-side instead (VC013)."""
+    if evidence.get("family") == "txn":
+        checks = []
+        diags = certify_cycle_witness(
+            evidence.get("result") or {}, evidence.get("history") or [],
+            evidence.get("workload", "append"), evidence.get("opts"),
+            checks=checks)
+        rep = to_json(diags)
+        confirmed = any(c.get("name") == "cycle-witness"
+                        and c.get("status") == "confirmed"
+                        for c in checks)
+        return {"schema": SCHEMA, "verdict": False, "family": "txn",
+                "engine": f"txn-{evidence.get('workload', 'append')}",
+                "key": None,
+                "rows": len(evidence.get("history") or []),
+                "confirmed": confirmed, "checks": checks,
+                "diagnostics": rep["diagnostics"],
+                "counts": rep["counts"]}, diags
     spec = evidence["spec"]
     e = evidence["e"]
     init_state = evidence["init_state"]

@@ -32,10 +32,13 @@ Codes:
   PL013 mixed    streaming-monitor knobs: non-positive / non-integer
                  monitor chunk (error); monitor-chunk without monitor,
                  an unknown monitor engine, a checker family with no
-                 incremental engine (e.g. the cycle checker), or
-                 op-timeout-ms armed alongside the monitor (each
-                 harness-timeout op stays permanently open in the
-                 monitor's incremental encoding) -- warnings
+                 incremental engine AND no ``family: "txn"`` config
+                 (the transactional family has its own streaming
+                 engine, so the cycle checker no longer implies
+                 monitor-off), or op-timeout-ms armed alongside the
+                 monitor (each harness-timeout op stays permanently
+                 open in the monitor's incremental encoding) --
+                 warnings
   PL014 mixed    fleet config invalid: no/empty/duplicate worker ids,
                  non-positive lease seconds, --serve with zero device
                  slots, unknown backend tier names (errors); a lease
@@ -131,6 +134,18 @@ Codes:
                  -- errors; a coordinator lease TTL at or beyond the
                  cell lease (detection slower than the work it
                  guards) -- warning
+  PL025 mixed    transactional monitor (``family: "txn"``): an
+                 unknown txn workload, an anomaly name outside the
+                 engine's taxonomy, ``realtime: False`` while
+                 *-realtime anomaly classes are explicitly requested,
+                 *-process classes requested without ``process:
+                 True`` (the per-process edges would never be
+                 inferred), or a txn-family monitor on a test whose
+                 checker tree carries a Linearizable gate (register
+                 model -- the two families encode histories
+                 differently and the verdicts are not comparable) --
+                 errors; a txn monitor with a negative / non-numeric
+                 skew-bound -- warning
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -662,6 +677,9 @@ def monitor_diags(test):
             "plan.monitor.chunk",
             "the monitor batches this many completed ops per "
             "incremental check (default 64)"))
+    if cfg.get("family") == "txn":
+        diags += _txn_monitor_diags(test, cfg)
+        return diags
     engine = cfg.get("engine")
     if engine is not None and engine not in mengine.ENGINES:
         diags.append(diag(
@@ -684,6 +702,8 @@ def monitor_diags(test):
                 "cycle checker) has no incremental engine, so the "
                 "monitor will disable itself at runtime",
                 "plan.monitor",
+                "for transactional workloads set monitor family "
+                '"txn" (the streaming cycle engine); otherwise '
                 "monitor workloads checked by checkers.linearizable "
                 "(directly, composed, or under independent)"))
     ot = test.get("op-timeout-ms")
@@ -699,6 +719,100 @@ def monitor_diags(test):
             "plan.monitor",
             "prefer fixing wedged clients over monitoring around "
             "them, or raise the op timeout"))
+    return diags
+
+
+def _txn_monitor_diags(test, cfg):
+    """The PL025 rules over a ``family: "txn"`` monitor config.
+
+    The transactional family has its own streaming engine
+    (monitor/txn.py), so none of the WGL-specific PL013 rules apply
+    -- but the txn knobs have their own failure modes: anomaly names
+    the cycle engine has never heard of are silently never detected,
+    *-realtime / *-process classes need their edge-inference flag on,
+    and pointing the txn monitor at a register-model test compares
+    verdicts across incompatible encodings."""
+    diags = []
+    from .. import monitor as jmonitor
+    from ..cycle import DEFAULT_ANOMALIES, PROCESS_ANOMALIES
+    from ..monitor import engine as mengine
+
+    workload = cfg.get("workload", "append")
+    if workload not in mengine.TXN_WORKLOADS:
+        diags.append(diag(
+            "PL025", ERROR,
+            f"unknown txn workload {workload!r}: known "
+            f"{list(mengine.TXN_WORKLOADS)}",
+            "plan.monitor.workload"))
+
+    known = set(DEFAULT_ANOMALIES) | set(PROCESS_ANOMALIES)
+    anomalies = cfg.get("anomalies")
+    requested = ()
+    if anomalies is not None:
+        if not isinstance(anomalies, (list, tuple)) \
+                or not all(isinstance(a, str) for a in anomalies):
+            diags.append(diag(
+                "PL025", ERROR,
+                f"txn anomalies must be a list of names, got "
+                f"{anomalies!r}",
+                "plan.monitor.anomalies"))
+        else:
+            requested = tuple(anomalies)
+            unknown = sorted(set(requested) - known)
+            if unknown:
+                diags.append(diag(
+                    "PL025", ERROR,
+                    f"unknown txn anomaly name(s) {unknown}: the "
+                    "cycle engine would silently never detect them "
+                    f"(known: {sorted(known)})",
+                    "plan.monitor.anomalies"))
+    rt_req = [a for a in requested if a.endswith("-realtime")]
+    if rt_req and cfg.get("realtime") is False:
+        diags.append(diag(
+            "PL025", ERROR,
+            f"realtime edge inference is off but {rt_req} are "
+            "requested: without RT edges these classes can never "
+            "cycle",
+            "plan.monitor.realtime",
+            "drop realtime: False or the *-realtime anomaly classes"))
+    proc_req = [a for a in requested if a.endswith("-process")]
+    if proc_req and not cfg.get("process"):
+        diags.append(diag(
+            "PL025", ERROR,
+            f"per-process edge inference is off (the default) but "
+            f"{proc_req} are requested: without process edges these "
+            "classes can never cycle",
+            "plan.monitor.process",
+            "set monitor process: True alongside *-process classes"))
+
+    checker = test.get("checker")
+    if checker is not None:
+        try:
+            lin, _keyed = jmonitor.find_linearizable(checker)
+        except Exception:  # noqa: BLE001 - reflection is best-effort
+            lin = None
+        if lin is not None:
+            diags.append(diag(
+                "PL025", ERROR,
+                'monitor family "txn" on a test whose checker tree '
+                "carries a Linearizable gate: the register model "
+                "encodes [f k v] reads/writes, the txn engine "
+                "encodes micro-op transactions -- the streaming "
+                "verdict would not be comparable to the offline one",
+                "plan.monitor.family",
+                "drop the family override (the WGL monitor handles "
+                "register models) or switch the workload to the "
+                "transactional suite"))
+
+    skew = cfg.get("skew-bound", cfg.get("skew_bound"))
+    if skew is not None and (not isinstance(skew, (int, float))
+                             or isinstance(skew, bool) or skew < 0):
+        diags.append(diag(
+            "PL025", WARNING,
+            f"txn skew-bound should be a non-negative number of "
+            f"nanoseconds, got {skew!r}: the default (0: trust "
+            "realtime stamps exactly) applies instead",
+            "plan.monitor.skew-bound"))
     return diags
 
 

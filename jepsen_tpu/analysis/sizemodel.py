@@ -30,7 +30,7 @@ from __future__ import annotations
 __all__ = ["INT32_CELL_LIMIT", "BYTES_PER_CELL", "bucket", "n_floor",
            "bucket_for", "plan_sizes", "history_cells", "history_ranks",
            "buffer_cells", "int32_wall", "hbm_bytes", "search_shape",
-           "ledger_key_shape"]
+           "closure_shape", "ledger_key_shape"]
 
 #: cells (int32 lanes) addressable before device indices overflow --
 #: the wall the packed-encoding roadmap item exists to break
@@ -194,6 +194,40 @@ def search_shape(model, n_ops, *, keys=1, concurrency=None,
         "hbm": hbm_bytes(n_pad, S, C, keys=keys, arg_width=A,
                          sizes=(B, W, O, T)),
         "int32": int32_wall(n_pad, arg_width=A, keys=keys, S=S, C=C),
+    }
+
+
+def closure_shape(n_txns, *, lo=64):
+    """The symbolic prediction for one transactional cycle probe
+    (``cycle.IncrementalClosure`` / ``batch_closure_probe``): the
+    txn-count pads to a pow-2 bucket (floor ``lo``, the device
+    threshold) and the device keeps the float32 reachability frontier
+    plus the bool adjacency resident -- ``n_pad^2`` lanes each, one
+    extra ``n_pad^2`` transient for the squaring step. ``passes`` is
+    the fixpoint bound per from-scratch closure (ceil(log2 n));
+    incremental updates cost ~2. No ModelSpec exists for this engine
+    -- that is the point: capplan's ``engine == "txn-closure"`` branch
+    routes here instead of `search_shape`."""
+    import math as _math
+    n_txns = int(n_txns)
+    n_pad = bucket(max(1, n_txns), lo)
+    per = BYTES_PER_CELL
+    hbm = {
+        "adjacency": n_pad * n_pad * 1,          # bool, 1 byte/lane
+        "frontier": n_pad * n_pad * per,         # float32 closure
+        "step": n_pad * n_pad * per,             # r @ r transient
+    }
+    hbm["total"] = sum(hbm.values())
+    cells = n_pad * n_pad
+    return {
+        "model": "txn-closure",
+        "engine": "txn-closure",
+        "n_ops": n_txns,
+        "bucket": n_pad,
+        "passes": max(1, int(_math.ceil(_math.log2(max(2, n_pad))))),
+        "hbm": hbm,
+        "int32": {"cells": cells, "which": "closure frontier",
+                  "frac": round(cells / INT32_CELL_LIMIT, 6)},
     }
 
 

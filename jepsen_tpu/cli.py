@@ -553,7 +553,10 @@ def _add_campaign_opts(parser, axes=False):
                                  "downloads, worker kill -9s) into "
                                  "the dispatch control plane; "
                                  "profiles: none, flaky-exec, "
-                                 "lossy-sync, soak (e.g. soak:42).")
+                                 "lossy-sync, soak, coordinator-kill, "
+                                 "txn-skew (per-worker clock skew for "
+                                 "the transactional family) "
+                                 "(e.g. soak:42).")
         parser.add_argument("--coordinator-lease-s", type=float,
                             default=None, metavar="SECONDS",
                             help="Coordinator HA (fleet.ha): renew a "

@@ -44,6 +44,9 @@ them via the ``anomalies`` tuple (which auto-enables the edges) or
 
 from __future__ import annotations
 
+import threading as _threading
+import time as _time
+
 import numpy as np
 
 WW = 1
@@ -97,14 +100,35 @@ def invocation_times(history):
 UNKNOWN_INVOKE = np.int64(2) ** 62
 
 
-def add_realtime_edges(graph, ops, completed_at, invoked_at):
+def skew_bound_from_offsets(offsets, scale=1.0):
+    """Conservative clock-skew bound from per-worker clock offsets (the
+    obs/merge ``worker_offsets`` map): the spread max-min over the
+    offsets plus the coordinator's implicit 0.0. Two timestamps from
+    workers whose clocks disagree by up to this much can be reordered by
+    up to this much, so an RT edge is only trustworthy when the gap
+    exceeds the bound. ``scale`` converts offset units into history time
+    units (worker offsets are seconds; merged history times are ns, so
+    pass 1e9 there)."""
+    if isinstance(offsets, dict):
+        offsets = offsets.values()
+    vals = [0.0] + [float(v) for v in offsets]
+    return (max(vals) - min(vals)) * scale
+
+
+def add_realtime_edges(graph, ops, completed_at, invoked_at,
+                       skew_bound=0):
     """Bulk-add RT edges: a -> b iff a COMPLETED before b was INVOKED
     (the strict-serializability order). ``invoked_at`` returning None
     means the invocation is unknown: that op gets no incoming RT edge.
     Symmetrically, ``completed_at`` returning None means the completion
     is unknown: that op gets no OUTGOING edge (treating it as 0 would
     place it before everything and fabricate realtime edges in
-    partially-timed histories -- advisor finding r3). Vectorized;
+    partially-timed histories -- advisor finding r3).
+
+    ``skew_bound`` (history time units) makes the inference skew-aware:
+    an edge is only added when the realtime gap exceeds the recovered
+    per-worker clock-offset bound, so a worker whose clock runs e.g.
+    30s behind cannot fabricate strictness nobody witnessed. Vectorized;
     per-edge explanations are skipped (the edge name "rt" is
     self-describing and a dense realtime order would mean O(n^2)
     strings)."""
@@ -114,7 +138,8 @@ def add_realtime_edges(graph, ops, completed_at, invoked_at):
                        else t for op in ops], np.int64)
     inv = np.asarray([UNKNOWN_INVOKE if (t := invoked_at(op)) is None
                       else t for op in ops], np.int64)
-    rt = comp[:, None] < inv[None, :]
+    bound = np.int64(min(max(0, int(skew_bound)), 2 ** 61))
+    rt = (comp[:, None] + bound) < inv[None, :]
     rt &= inv[None, :] != UNKNOWN_INVOKE
     rt &= comp[:, None] != UNKNOWN_INVOKE
     np.fill_diagonal(rt, False)
@@ -175,6 +200,37 @@ def _bucket_pow2(n: int, lo: int = 64) -> int:
 
 _closure_cache: dict[int, object] = {}
 
+#: module-wide squaring-pass counter: every closure pass (one R|R@R
+#: squaring, host or device, batched counted once per batch) increments
+#: it. The txn monitor's incrementality contract is asserted against
+#: this counter -- per-chunk cost in *passes*, not wall clock. Guarded:
+#: the monitor thread and the interpreter both run closures.
+_closure_lock = _threading.Lock()
+_closure_stats = {"passes": 0}
+
+
+def _count_passes(n: int):
+    with _closure_lock:
+        _closure_stats["passes"] += int(n)
+
+
+def closure_passes() -> int:
+    """Total squaring passes performed since import (monotonic)."""
+    return _closure_stats["passes"]
+
+
+def _busy(dt: float):
+    """Device-occupancy numerator for the metrics plane: every device
+    closure dispatch brackets its synced wall here, the same counter
+    shape ``wgl.device_busy_s`` gives the search engines, so duty-cycle
+    readers (bench rung 15, obs/merge) see closure compute too."""
+    from .. import obs
+    obs.inc("txn.closure_busy_s", float(dt), engine="txn-closure")
+
+
+def _steps_for(n: int) -> int:
+    return max(1, int(np.ceil(np.log2(max(2, n)))))
+
 
 def _device_closure(n_pad: int):
     """Jitted transitive closure by repeated squaring: R |= R@R until
@@ -183,7 +239,7 @@ def _device_closure(n_pad: int):
     import jax.numpy as jnp
     from jax import lax
 
-    steps = max(1, int(np.ceil(np.log2(max(2, n_pad)))))
+    steps = _steps_for(n_pad)
 
     @jax.jit
     def run(a):
@@ -207,7 +263,9 @@ def transitive_closure(adj: np.ndarray) -> np.ndarray:
     a = adj.astype(bool)
     if n <= 64:
         r = a.copy()
-        for _ in range(max(1, int(np.ceil(np.log2(max(2, n)))))):
+        steps = _steps_for(n)
+        _count_passes(steps)
+        for _ in range(steps):
             r = r | (r @ r)
         return r
     n_pad = _bucket_pow2(n)
@@ -219,7 +277,196 @@ def transitive_closure(adj: np.ndarray) -> np.ndarray:
         # codelint: ok -- benign compile race: both racers build the
         # same jitted closure, last write wins
         _closure_cache[n_pad] = fn
-    return np.asarray(fn(padded))[:n, :n]
+    _count_passes(_steps_for(n_pad))
+    t0 = _time.perf_counter()
+    out = np.asarray(fn(padded))
+    _busy(_time.perf_counter() - t0)
+    return out[:n, :n]
+
+
+_step_cache: dict[int, object] = {}
+
+
+def _device_step(n_pad: int):
+    """One jitted squaring pass with a changed flag, for fixpoint loops
+    that stop early (the incremental frontier usually converges in a
+    couple of passes after a single-txn delta)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(r):
+        rr = ((r @ r + r) > 0).astype(jnp.float32)
+        return rr, jnp.any(rr != r)
+
+    return step
+
+
+class IncrementalClosure:
+    """Transitive-closure frontier maintained across monitor chunks.
+
+    The frontier R (reachability so far) stays resident between
+    ``update`` calls -- on the device for padded sizes above the host
+    threshold -- so folding a new committed txn in costs one row/col
+    delta OR plus a couple of squaring passes to re-reach fixpoint,
+    instead of a from-scratch O(n^3 log n) closure. Squaring an
+    already-closed R plus a sparse delta converges in O(1) passes for a
+    bounded delta (each pass splices the new edges through existing
+    reachability), which is what makes chunked monitoring cheap; the
+    pass counter (``closure_passes``) is the asserted contract.
+
+    Growing past the current pow-2 bucket rebuilds from scratch (rare:
+    log2(n/lo) rebuilds over a whole run)."""
+
+    def __init__(self, lo: int = 64):
+        self.lo = int(lo)
+        self.n = 0
+        self.n_pad = 0
+        self.rebuilds = 0
+        self._adj = None     # padded host bool: edges folded in so far
+        self._r = None       # padded frontier: host bool or device f32
+
+    def _fixpoint(self, r):
+        """Square ``r`` until unchanged, counting passes. Accepts a
+        padded host bool array or a padded device float32 array."""
+        if self.n_pad <= 64:
+            r = np.asarray(r, dtype=bool)
+            while True:
+                rr = r | (r @ r)
+                _count_passes(1)
+                if (rr == r).all():
+                    return rr
+                r = rr
+        import jax.numpy as jnp
+        fn = _step_cache.get(self.n_pad)
+        if fn is None:
+            fn = _device_step(self.n_pad)
+            # codelint: ok -- benign compile race
+            _step_cache[self.n_pad] = fn
+        if isinstance(r, np.ndarray):
+            r = jnp.asarray(r.astype(np.float32))
+        t0 = _time.perf_counter()
+        try:
+            while True:
+                r, changed = fn(r)
+                # bool(changed) syncs, so the bracket is device wall
+                _count_passes(1)
+                if not bool(changed):
+                    return r
+        finally:
+            _busy(_time.perf_counter() - t0)
+
+    def update(self, adj) -> "IncrementalClosure":
+        """Fold the current full adjacency (n x n bool-ish; n may have
+        grown) into the frontier. New edges are OR'd in and the frontier
+        re-squared to fixpoint."""
+        adj = np.asarray(adj, dtype=bool)
+        n = adj.shape[0]
+        n_pad = _bucket_pow2(max(n, 1), self.lo)
+        if self._r is None or n_pad != self.n_pad:
+            self.n_pad = n_pad
+            self.n = n
+            self.rebuilds += 1
+            self._adj = np.zeros((n_pad, n_pad), dtype=bool)
+            self._adj[:n, :n] = adj
+            self._r = self._fixpoint(self._adj.copy())
+            return self
+        delta = np.zeros((n_pad, n_pad), dtype=bool)
+        delta[:n, :n] = adj
+        delta &= ~self._adj
+        self.n = max(self.n, n)
+        if not delta.any():
+            return self
+        self._adj |= delta
+        if isinstance(self._r, np.ndarray):
+            self._r = self._fixpoint(self._r | delta)
+        else:
+            import jax.numpy as jnp
+            self._r = self._fixpoint(
+                jnp.maximum(self._r, jnp.asarray(delta, jnp.float32)))
+        return self
+
+    def closure(self) -> np.ndarray:
+        """Host bool n x n reachability (>=1 step) view of the frontier."""
+        if self._r is None:
+            return np.zeros((0, 0), dtype=bool)
+        r = np.asarray(self._r)
+        if r.dtype != bool:
+            r = r > 0
+        return r[:self.n, :self.n]
+
+    def has_cycle(self) -> bool:
+        """Any node reaching itself -- the streaming suspicion signal."""
+        if self._r is None or self.n == 0:
+            return False
+        r = np.asarray(self._r)
+        diag = np.diagonal(r[:self.n, :self.n])
+        return bool((diag > 0).any() if diag.dtype != bool
+                    else diag.any())
+
+
+_batch_closure_cache: dict[int, object] = {}
+
+
+def _batch_device_closure(n_pad: int):
+    """Jitted batched closure probe: close every graph in a [B, n, n]
+    stack in one go and return per-graph has-cycle (diagonal-any)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    steps = _steps_for(n_pad)
+
+    @jax.jit
+    def run(a):
+        def body(_, r):
+            rr = (jnp.matmul(r, r) + r) > 0
+            return rr.astype(jnp.float32)
+
+        r = lax.fori_loop(0, steps, body, a)
+        return jnp.trace(r, axis1=-2, axis2=-1) > 0
+
+    return run
+
+
+def batch_closure_probe(adjs, n_floor: int = 64) -> list[bool]:
+    """Has-cycle probe for a coalesced batch of txn dependency graphs:
+    pad each bool adjacency to the batch's common pow-2 bucket, stack
+    [B, n, n], run ONE cached batched closure, read per-graph
+    diagonal-any. Soundness: every Adya cycle class requires a cycle in
+    the full-mask graph (RT edges alone are an interval order, hence
+    acyclic), so probe-acyclic => valid for any requested anomaly
+    subset. Probe-cyclic graphs still need full offline classification
+    (the cycle may use only edges outside the requested classes)."""
+    if not adjs:
+        return []
+    mats = [np.asarray(a, dtype=bool) for a in adjs]
+    n_max = max((m.shape[0] for m in mats), default=1)
+    n_pad = _bucket_pow2(max(n_max, 1), n_floor)
+    if n_pad <= 64:
+        out = []
+        steps = _steps_for(n_pad)
+        _count_passes(steps)
+        for m in mats:
+            r = m.copy()
+            for _ in range(steps):
+                r = r | (r @ r)
+            out.append(bool(np.diagonal(r).any()))
+        return out
+    stack = np.zeros((len(mats), n_pad, n_pad), dtype=np.float32)
+    for b, m in enumerate(mats):
+        n = m.shape[0]
+        stack[b, :n, :n] = m
+    fn = _batch_closure_cache.get(n_pad)
+    if fn is None:
+        fn = _batch_device_closure(n_pad)
+        # codelint: ok -- benign compile race
+        _batch_closure_cache[n_pad] = fn
+    _count_passes(_steps_for(n_pad))
+    t0 = _time.perf_counter()
+    out = [bool(v) for v in np.asarray(fn(stack))]
+    _busy(_time.perf_counter() - t0)
+    return out
 
 
 def find_path(adj: np.ndarray, src: int, dst: int) -> list[int] | None:

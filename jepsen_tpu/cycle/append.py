@@ -92,15 +92,17 @@ def _value_cycle(edges):
     return None
 
 
-def analyze(history, anomalies=DEFAULT_ANOMALIES,
-            realtime=True, process=False) -> dict:
-    """Infer the dependency graph from an append history and classify its
-    anomalies. Returns the check_graph result plus inference-level
-    anomalies. ``realtime`` adds RT (completed-before-invoked) edges,
-    enabling the strict-serializability *-realtime classes;
-    ``process`` adds per-process order edges, enabling the
-    sequential-consistency *-process classes (off by default, and
-    auto-enabled when a *-process anomaly is requested)."""
+def infer(history, anomalies=DEFAULT_ANOMALIES,
+          realtime=True, process=False, skew_bound=0):
+    """Infer the dependency graph from an append history WITHOUT
+    classifying cycles. Returns ``(graph, found, oks)`` where ``found``
+    maps inference-level anomaly names (duplicates, incompatible-order,
+    cyclic-versions, G1a, G1b, garbage-read) to witness lists and
+    ``oks`` indexes the graph's nodes. The streaming monitor and the
+    service's batched probe build on this; ``analyze`` layers the cycle
+    classification on top. ``skew_bound`` (history time units) gates RT
+    edges on the realtime gap exceeding the recovered clock-offset
+    bound."""
     history = [op for op in history if op.get("f") in ("txn", None)]
     inv_time = invocation_times(history)
     oks = [op for op in history if op.get("type") == "ok"]
@@ -253,11 +255,25 @@ def analyze(history, anomalies=DEFAULT_ANOMALIES,
         # everything -- advisor finding r3)
         add_realtime_edges(
             graph, oks, lambda op: op.get("time"),
-            lambda op: inv_time.get(id(op)))
+            lambda op: inv_time.get(id(op)), skew_bound=skew_bound)
 
     if process or any(a.endswith("-process") for a in anomalies):
         add_process_edges(graph, oks)
 
+    return graph, found, oks
+
+
+def analyze(history, anomalies=DEFAULT_ANOMALIES,
+            realtime=True, process=False, skew_bound=0) -> dict:
+    """Infer the dependency graph from an append history and classify its
+    anomalies. Returns the check_graph result plus inference-level
+    anomalies. ``realtime`` adds RT (completed-before-invoked) edges,
+    enabling the strict-serializability *-realtime classes;
+    ``process`` adds per-process order edges, enabling the
+    sequential-consistency *-process classes (off by default, and
+    auto-enabled when a *-process anomaly is requested)."""
+    graph, found, oks = infer(history, anomalies, realtime, process,
+                              skew_bound)
     res = check_graph(graph, oks, anomalies)
     res["anomalies"].update(found)
     res["anomaly_types"] = sorted(set(res["anomaly_types"]) |
@@ -279,6 +295,8 @@ def check(history, opts=None) -> dict:
     anomalies = tuple(opts.get("anomalies", DEFAULT_ANOMALIES))
     res = analyze(h.complete(history), anomalies,
                   realtime=opts.get("realtime", True),
-                  process=opts.get("process", False))
+                  process=opts.get("process", False),
+                  skew_bound=opts.get("skew-bound",
+                                      opts.get("skew_bound", 0)))
     res["valid?"] = res["valid"]
     return res

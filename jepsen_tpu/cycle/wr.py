@@ -41,7 +41,13 @@ def _txn(op):
     return op.get("value") or []
 
 
-def analyze(history, opts=None) -> dict:
+def infer(history, opts=None):
+    """Infer the dependency graph from an rw-register history WITHOUT
+    classifying cycles. Returns ``(graph, found, oks, garbage)`` --
+    ``found`` maps inference-level anomaly names to witness lists,
+    ``garbage`` lists reads of values nobody is known to have written.
+    The streaming monitor and the service's batched probe build on
+    this; ``analyze`` layers the cycle classification on top."""
     opts = opts or {}
     anomalies = tuple(opts.get("anomalies", DEFAULT_ANOMALIES))
     history = [op for op in history if op.get("f") in ("txn", None)]
@@ -224,7 +230,9 @@ def analyze(history, opts=None) -> dict:
         # time were witnessed (op.get("time") is None otherwise)
         add_realtime_edges(graph, oks,
                            lambda op: op.get("time"),
-                           lambda op: inv_time.get(id(op)))
+                           lambda op: inv_time.get(id(op)),
+                           skew_bound=opts.get(
+                               "skew-bound", opts.get("skew_bound", 0)))
 
     if opts.get("process") or any(a.endswith("-process")
                                   for a in anomalies):
@@ -233,6 +241,13 @@ def analyze(history, opts=None) -> dict:
         # default, like elle's :sequential analysis)
         add_process_edges(graph, oks)
 
+    return graph, found, oks, garbage
+
+
+def analyze(history, opts=None) -> dict:
+    opts = opts or {}
+    anomalies = tuple(opts.get("anomalies", DEFAULT_ANOMALIES))
+    graph, found, oks, garbage = infer(history, opts)
     res = check_graph(graph, oks, anomalies)
     res["anomalies"].update(found)
     res["anomaly_types"] = sorted(set(res["anomaly_types"]) | set(found))

@@ -23,6 +23,7 @@ from .checker import checkers as cks
 from .tests import bank as bank_workload
 from .tests import linearizable_register
 from .tests.cycle import append as append_workload
+from .tests.cycle import wr as wr_workload
 
 
 class DemoState:
@@ -34,6 +35,7 @@ class DemoState:
         self.balances = {}
         self.set = set()
         self.lists = {}
+        self.kv = {}
 
 
 class DemoDB(jdb.DB):
@@ -45,6 +47,7 @@ class DemoDB(jdb.DB):
             self.state.registers.clear()
             self.state.set.clear()
             self.state.lists.clear()
+            self.state.kv.clear()
             accounts = test.get("accounts") or []
             total = test.get("total-amount") or 0
             if accounts:
@@ -184,7 +187,11 @@ def set_workload(opts, state):
 class AppendClient(jclient.Client):
     """Transactional list-append over shared per-key lists. The
     dirty-read bug occasionally reverses a read, which the cycle
-    checker flags as an incompatible order."""
+    checker flags as an incompatible order; the future-read bug makes
+    every 5th read *predict* the next append (returning got +
+    [max+1]), so the eventual writer of that value precedes the read
+    in the dependency graph while realtime orders them the other way
+    -- a G1c-realtime cycle the streaming monitor catches live."""
 
     def __init__(self, state, bug=None):
         self.state = state
@@ -197,17 +204,66 @@ class AppendClient(jclient.Client):
     def invoke(self, test, op):
         out = dict(op)
         txn = []
+        # the future-read prediction must stay cross-txn (a txn
+        # predicting a value IT then appends reads as a within-txn
+        # incompatible order, not the clean G1c signal)
+        own_appends = {k for f, k, _ in op["value"] if f == "append"}
         with self.state.lock:
             self._n += 1
             for f, k, v in op["value"]:
                 if f == "append":
-                    self.state.lists.setdefault(k, []).append(v)
+                    lst = self.state.lists.setdefault(k, [])
+                    # store-assigned contiguous per-key values:
+                    # generated values apply out of order under
+                    # concurrency, which would leave gaps the
+                    # future-read prediction trips over
+                    v = lst[-1] + 1 if lst else 1
+                    lst.append(v)
                     txn.append([f, k, v])
                 else:
                     got = list(self.state.lists.get(k, []))
                     if self.bug == "dirty-read" and self._n % 7 == 0 \
                             and len(got) >= 2:
                         got = got[::-1]
+                    elif self.bug == "future-read" \
+                            and self._n % 5 == 0 and got \
+                            and k not in own_appends:
+                        got = got + [max(got) + 1]
+                    txn.append([f, k, got])
+        out.update(type="ok", value=txn)
+        return out
+
+
+class WrClient(jclient.Client):
+    """Transactional write/read over shared per-key registers (the
+    rw-register family). The stale-read bug serves every 7th read from
+    the key's *previous* version, which the wr cycle checker flags via
+    rw/wr conflict cycles."""
+
+    def __init__(self, state, bug=None):
+        self.state = state
+        self.bug = bug
+        self._n = 0
+
+    def open(self, test, node):
+        return WrClient(self.state, self.bug)
+
+    def invoke(self, test, op):
+        out = dict(op)
+        txn = []
+        with self.state.lock:
+            self._n += 1
+            for f, k, v in op["value"]:
+                if f == "w":
+                    prev = self.state.kv.get(k, (None, None))[0]
+                    self.state.kv[k] = (v, prev)
+                    txn.append([f, k, v])
+                else:
+                    cur, prev = self.state.kv.get(k, (None, None))
+                    got = cur
+                    if self.bug in ("stale-read", "dirty-read") \
+                            and self._n % 7 == 0 and prev is not None:
+                        got = prev
                     txn.append([f, k, got])
         out.update(type="ok", value=txn)
         return out
@@ -217,6 +273,13 @@ def append_workload_fn(opts, state):
     w = append_workload.test({"key-count": 3, "max-txn-length": 3})
     return {**w,
             "client": AppendClient(state, opts.get("bug")),
+            "generator": gen.clients(gen.stagger(0.001, w["generator"]))}
+
+
+def wr_workload_fn(opts, state):
+    w = wr_workload.test({"key-count": 3, "max-txn-length": 3})
+    return {**w,
+            "client": WrClient(state, opts.get("bug")),
             "generator": gen.clients(gen.stagger(0.001, w["generator"]))}
 
 
@@ -232,14 +295,85 @@ WORKLOADS = {
     "bank": bank_workload_fn,
     "set": set_workload,
     "append": append_workload_fn,
+    "wr": wr_workload_fn,
     "noop": noop_workload,
 }
+
+#: workloads whose histories are transactions over jepsen_tpu.cycle
+#: mops -- the txn monitor family applies to exactly these
+TXN_WORKLOADS = ("append", "wr")
+
+
+def nemesis_axis(mode):
+    """The ``nemesis`` campaign axis: None/"none" -> noop; "faketime" ->
+    the libfaketime clock nemesis; "charybdefs" -> FUSE EIO injection.
+    Both real nemeses need a real cluster; under the demo's dummy ssh
+    their control calls are contained into info completions so the same
+    campaign matrix runs everywhere."""
+    from . import nemesis as jnemesis
+    if mode in (None, "none"):
+        return jnemesis.noop, None
+    if mode == "faketime":
+        from .nemesis import time as ntime
+        nem = _contained(ntime.ClockNemesis())
+        return nem, gen.stagger(2, ntime.clock_gen())
+    if mode == "charybdefs":
+        from . import charybdefs
+
+        def start(test, node):
+            charybdefs.break_one_percent()
+            return "charybdefs-1pct"
+
+        def stop(test, node):
+            charybdefs.clear()
+            return "charybdefs-clear"
+
+        nem = _contained(jnemesis.node_start_stopper(
+            lambda nodes: list(nodes), start, stop))
+        return nem, gen.stagger(2, gen.cycle(
+            gen.once({"type": "info", "f": "start"}),
+            gen.once({"type": "info", "f": "stop"})))
+    raise ValueError(f"unknown nemesis axis value {mode!r}; "
+                     "expected none/faketime/charybdefs")
+
+
+def _contained(nemesis_obj):
+    """Wrap a real-cluster nemesis so control-layer failures (no sshd,
+    dummy remotes, missing tooling) become info completions instead of
+    run-killing crashes."""
+    from . import nemesis as jnemesis
+
+    class _Contained(jnemesis.Nemesis):
+        def setup(self, test):
+            try:
+                return _contained(nemesis_obj.setup(test))
+            except Exception:  # noqa: BLE001 - demo must survive
+                return self
+
+        def invoke(self, test, op):
+            try:
+                return nemesis_obj.invoke(test, op)
+            except Exception as exc:  # noqa: BLE001
+                out = dict(op)
+                out.update(type="info",
+                           value=["nemesis-unavailable", repr(exc)[:200]])
+                return out
+
+        def teardown(self, test):
+            try:
+                nemesis_obj.teardown(test)
+            except Exception:  # noqa: BLE001
+                pass
+
+        def fs(self):
+            return nemesis_obj.fs()
+
+    return _Contained()
 
 
 def demo_test(options):
     """Build a full test map from parsed CLI options (the suite's
     test-fn)."""
-    from . import nemesis as jnemesis
     from .os import noop as os_noop
 
     state = DemoState()
@@ -254,8 +388,11 @@ def demo_test(options):
                           (concurrency + group - 1) // group * group)
     options = {**options, "concurrency": concurrency}
     workload = WORKLOADS[name](options, state)
-    generator = gen.time_limit(options.get("time-limit", 60),
-                               workload["generator"])
+    nem, nem_gen = nemesis_axis(options.get("nemesis"))
+    body = workload["generator"]
+    if nem_gen is not None:
+        body = gen.nemesis(nem_gen, body)
+    generator = gen.time_limit(options.get("time-limit", 60), body)
     checker = cc.compose({
         "workload": workload["checker"],
         "stats": cks.stats(),
@@ -269,7 +406,7 @@ def demo_test(options):
         "ssh": options.get("ssh", {"dummy?": True}),
         "os": os_noop,
         "db": DemoDB(state),
-        "nemesis": jnemesis.noop,
+        "nemesis": nem,
         "client": workload["client"],
         "generator": generator,
         "checker": checker,
@@ -286,6 +423,25 @@ def demo_test(options):
               "progress-interval-s", "telemetry-flush-ms"):
         if options.get(k) is not None:
             test[k] = options[k]
+    # transactional workloads monitor through the txn family: normalize
+    # test["monitor"] to a dict and route it to monitor/txn.py (the WGL
+    # path would find no linearizable gate in the cycle checker tree)
+    if test.get("monitor") and name in TXN_WORKLOADS:
+        mcfg = test["monitor"]
+        if mcfg is True:
+            mcfg = {}
+        elif isinstance(mcfg, int):
+            mcfg = {"chunk": mcfg}
+        else:
+            mcfg = dict(mcfg)
+        mcfg.setdefault("family", "txn")
+        mcfg.setdefault("workload", name)
+        if options.get("skew-bound-s"):
+            # e.g. planted by the txn-skew chaos profile: history
+            # times are ns, the bound arrives in seconds
+            mcfg.setdefault("skew-bound",
+                            int(float(options["skew-bound-s"]) * 1e9))
+        test["monitor"] = mcfg
     if name == "bank":
         # the workload bundle already carries the generator's constants
         test.update({k: workload[k] for k in ("accounts", "total-amount",

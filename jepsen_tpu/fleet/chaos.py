@@ -85,6 +85,14 @@ class ChaosProfile:
     #: marker): the fleet.ha standby must detect the dead lease, fence
     #: the coordinator, and finish the campaign
     coordinator_kill: int = 0
+    #: per-worker wall-clock skew: each struck worker's clock stamps
+    #: (the PR-10 handshake legs ``worker-received-epoch`` /
+    #: ``worker-result-epoch``) shift by a seeded offset drawn from
+    #: [-clock_skew_max_s, +clock_skew_max_s]; ``obs.merge``'s
+    #: worker_offsets recovers it, and the txn family's realtime-edge
+    #: inference must stay sound under it (skew-bound gating)
+    clock_skew_p: float = 0.0
+    clock_skew_max_s: float = 0.0
 
     def with_seed(self, seed):
         return dataclasses.replace(self, seed=int(seed))
@@ -137,6 +145,25 @@ class ChaosProfile:
 
         return faults
 
+    def skew_for(self, worker_id):
+        """This worker's injected wall-clock offset in seconds (0.0
+        when unstruck): deterministic in (seed, worker), independent of
+        the transport-fault draws."""
+        if not self.clock_skew_p or not self.clock_skew_max_s:
+            return 0.0
+        rng = random.Random(f"{self.seed}|clock-skew|{worker_id}")
+        if rng.random() >= self.clock_skew_p:
+            return 0.0
+        return round(rng.uniform(-self.clock_skew_max_s,
+                                 self.clock_skew_max_s), 3)
+
+    def skew_bound_s(self):
+        """A sound bound on the pairwise clock disagreement this
+        profile can inject: the width of the offset envelope (both
+        tails) -- what a txn suite should pass as its skew bound."""
+        return 2.0 * float(self.clock_skew_max_s) \
+            if self.clock_skew_p and self.clock_skew_max_s else 0.0
+
     def plan_kills(self, cell_ids):
         """The deterministic set of cells whose FIRST lease kill -9s
         its worker (die-once markers make the second lease run)."""
@@ -187,6 +214,13 @@ PROFILES = {
         kills=1, torn_ledger_tail=True),
     "coordinator-kill": ChaosProfile(
         name="coordinator-kill", coordinator_kill=1),
+    # the txn family's clock soak: every worker's clock skews by up to
+    # +/-45s (plus mild exec flakiness so skew composes with retries);
+    # RT-edge inference must not fabricate anomalies from it
+    "txn-skew": ChaosProfile(
+        name="txn-skew",
+        clock_skew_p=1.0, clock_skew_max_s=45.0,
+        exec_exit255_p=0.2, exec_exit255_max=1),
 }
 
 

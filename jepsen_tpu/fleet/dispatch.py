@@ -685,6 +685,18 @@ def run_fleet(cells, workers, *, campaign_id=None, resume=False,
             spec["coordinator-epoch"] = jr.epoch
         if backends is not None:
             spec["backend"] = backends.choose()
+        if chaos is not None:
+            skew = chaos.skew_for(worker.id)
+            if skew:
+                # chaos clock skew: the worker shifts its handshake
+                # stamps by this much (a worker whose wall clock is
+                # simply wrong); obs.merge recovers it, and the bound
+                # rides into the cell options so skew-aware txn
+                # checkers gate their realtime edges on it
+                spec["clock-skew-s"] = skew
+                spec["options"] = dict(base_options,
+                                       **{"skew-bound-s":
+                                          chaos.skew_bound_s()})
         return spec
 
     def journal_sync(cell, wid, status, info=None, **extra):

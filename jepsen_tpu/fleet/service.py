@@ -368,6 +368,19 @@ _DEADLINE_RESULT = {"valid": "unknown",
                     "error": "request timeout budget exhausted"}
 
 
+class _TxnClosureSpec:
+    """The coalescer's stand-in "model" for transactional cycle
+    probes: txn tenants queue per (``txn-closure``, pow-2 txn-count
+    bucket) exactly like WGL tenants queue per (model, op bucket), and
+    one device squaring pass answers the whole batch
+    (``cycle.batch_closure_probe``)."""
+
+    name = "txn-closure"
+
+
+TXN_CLOSURE_SPEC = _TxnClosureSpec()
+
+
 class _PendingSegment:
     """One encoded segment waiting in (or delivered by) the batcher.
     ``result`` is read only after ``event`` is set; ``None`` then
@@ -486,6 +499,15 @@ class Coalescer:
                 self._thread.start()
             self._cond.notify_all()
         return item
+
+    def submit_closure(self, adj, deadline, owner="local"):
+        """Enqueue one txn adjacency matrix for a batched cycle probe
+        (the txn family's coalescing unit: key
+        (``txn-closure``, pow-2 txn-count bucket)). ``wait`` answers
+        ``{"cyclic": bool}``, the deadline "unknown", or None = probe
+        solo."""
+        return self.submit(TXN_CLOSURE_SPEC, adj, None, deadline,
+                           owner=owner)
 
     def wait(self, item):
         """Block until ``item``'s batch delivered or its request
@@ -618,14 +640,26 @@ class Coalescer:
         timeout_s = min(CHECK_TIMEOUT_CAP_S,
                         max(it.deadline for it in live) - now)
         try:
-            from ..parallel import keyshard
-            # pad the batch to its GROUP bucket, not a re-derived one:
-            # with capacity-plan pre-registration the group bucket may
-            # sit ABOVE every member's raw length, and the whole point
-            # is compiling at the planned (ledger-hitting) shape
-            results = keyshard.check_batch_encoded(
-                spec, [it.pair for it in live], timeout_s=timeout_s,
-                owners=[it.owner for it in live], n_floor=bucket)
+            if spec.name == TXN_CLOSURE_SPEC.name:
+                # txn tenants: ONE batched transitive-closure probe
+                # answers cyclic-or-not for every member's adjacency
+                # matrix (cycle classification stays host-side, and
+                # only for members that turn out cyclic)
+                from ..cycle import batch_closure_probe
+                flags = batch_closure_probe(
+                    [it.pair[0] for it in live],
+                    n_floor=bucket or 64)
+                results = [{"cyclic": bool(f)} for f in flags]
+            else:
+                from ..parallel import keyshard
+                # pad the batch to its GROUP bucket, not a re-derived
+                # one: with capacity-plan pre-registration the group
+                # bucket may sit ABOVE every member's raw length, and
+                # the whole point is compiling at the planned
+                # (ledger-hitting) shape
+                results = keyshard.check_batch_encoded(
+                    spec, [it.pair for it in live], timeout_s=timeout_s,
+                    owners=[it.owner for it in live], n_floor=bucket)
         except Exception:  # noqa: BLE001 - contained per batch
             logger.warning("coalesced batch failed; %d segment(s) "
                            "fall back to the solo path", len(live),
@@ -663,9 +697,10 @@ class Coalescer:
                 # the queue wait is also a named phase in the
                 # time-attribution plane (obs.phases): idle the bubble
                 # ledger books against "wait", not mystery residual
-                obs_phases.note_wait("jax-wgl-batch",
-                                     t_dispatch - it.enqueued,
-                                     owner=it.owner)
+                obs_phases.note_wait(
+                    spec.name if spec.name == TXN_CLOSURE_SPEC.name
+                    else "jax-wgl-batch",
+                    t_dispatch - it.enqueued, owner=it.owner)
         except Exception:  # noqa: BLE001
             logger.warning("coalesce accounting failed", exc_info=True)
 
@@ -1058,11 +1093,156 @@ def _certify_response(spec, out, payload):
         return {"certified": False, "error": "certification crashed"}
 
 
+def _check_txn_admitted(payload, hist, caller="local"):
+    """The ``"family": "txn"`` /api/check pipeline: host-side
+    dependency inference, a (coalesced) device cycle probe, and
+    offline Adya classification only for histories that earn it.
+    Payload keys: ``workload`` (append / wr), ``anomalies`` (requested
+    class names), ``realtime`` / ``process`` (edge flags),
+    ``skew-bound`` (ns; gates realtime edges), ``certify``,
+    ``coalesce``."""
+    from ..cycle import (DEFAULT_ANOMALIES, PROCESS_ANOMALIES,
+                         transitive_closure)
+    from ..monitor import engine as mengine
+
+    workload = payload.get("workload", "append")
+    if workload not in mengine.TXN_WORKLOADS:
+        raise ApiError(400, f"unknown txn workload {workload!r}; "
+                            f"known: {list(mengine.TXN_WORKLOADS)}")
+    known = set(DEFAULT_ANOMALIES) | set(PROCESS_ANOMALIES)
+    anomalies = payload.get("anomalies")
+    if anomalies is not None:
+        if not isinstance(anomalies, (list, tuple)) \
+                or not all(isinstance(a, str) for a in anomalies):
+            raise ApiError(400, "'anomalies' must be a list of "
+                                "anomaly-class names")
+        bad = sorted(set(anomalies) - known)
+        if bad:
+            raise ApiError(400, f"unknown anomaly class(es) {bad}; "
+                                f"known: {sorted(known)}")
+    for key in ("realtime", "process"):
+        if key in payload and not isinstance(payload[key], bool):
+            raise ApiError(400, f"{key!r} must be a boolean")
+    skew = payload.get("skew-bound", 0)
+    if not isinstance(skew, (int, float)) or isinstance(skew, bool) \
+            or skew < 0:
+        raise ApiError(400, "'skew-bound' must be a non-negative "
+                            "number (history time units)")
+    if not isinstance(payload.get("coalesce", True), bool):
+        raise ApiError(400, "'coalesce' must be a boolean")
+    if not isinstance(payload.get("certify", False), bool):
+        raise ApiError(400, "'certify' must be a boolean")
+    opts = {"anomalies": tuple(anomalies) if anomalies
+            else DEFAULT_ANOMALIES,
+            "realtime": payload.get("realtime", True),
+            "process": payload.get("process", False),
+            "skew-bound": int(skew)}
+    t0 = time.monotonic()
+    timeout_s = min(float(payload.get("timeout-s") or CHECK_TIMEOUT_S),
+                    CHECK_TIMEOUT_CAP_S)
+    deadline = t0 + timeout_s
+    from .. import history as jhistory
+    hist = jhistory.index([dict(o) for o in hist])
+    try:
+        if workload == "wr":
+            from ..cycle import wr as cycle_wr
+            graph, found, oks, _garbage = cycle_wr.infer(hist, opts)
+        else:
+            from ..cycle import append as cycle_app
+            graph, found, oks = cycle_app.infer(
+                hist, opts["anomalies"], realtime=opts["realtime"],
+                process=opts["process"], skew_bound=opts["skew-bound"])
+    except ApiError:
+        raise
+    except Exception as exc:  # noqa: BLE001 - bad input, not a 500
+        logger.warning("/api/check txn inference failed", exc_info=True)
+        raise ApiError(422, f"txn history could not be inferred: "
+                            f"{exc!r}") from None
+    suspicious = set(found) - {"garbage-read"}
+    garbage = found.get("garbage-read") or []
+    coalesced = None
+    cyclic = None
+    if not suspicious:
+        adj = graph.adj > 0
+        coal = coalescer()
+        if coal is not None and payload.get("coalesce", True) \
+                and len(adj):
+            try:
+                item = coal.submit_closure(adj, deadline, owner=caller)
+            except Exception:  # noqa: BLE001 - stopped/replaced
+                logger.warning("closure coalesce submit failed; "
+                               "probing solo", exc_info=True)
+            else:
+                r = coal.wait(item)
+                if isinstance(r, dict) and "cyclic" in r:
+                    cyclic = bool(r["cyclic"])
+                    coalesced = {"txns": len(adj)}
+        if cyclic is None and len(adj):
+            closure = transitive_closure(adj)
+            cyclic = bool(closure.diagonal().any())
+        cyclic = bool(cyclic)
+    if suspicious or cyclic:
+        # the offline engine owns every classified verdict: witnesses,
+        # anomaly names, and requested-subset semantics come from the
+        # same code the offline checker runs
+        res = mengine.check_txn_prefix(hist, workload, opts)
+    elif garbage:
+        res = {"valid": "unknown", "anomaly_types": [],
+               "anomalies": {"garbage-read": garbage}}
+    else:
+        res = {"valid": True, "anomaly_types": [], "anomalies": {}}
+    out = {"valid": res.get("valid"),
+           "family": "txn", "workload": workload,
+           "model": f"txn-{workload}", "engine": f"txn-{workload}",
+           "anomaly_types": list(res.get("anomaly_types") or ()),
+           "anomalies": res.get("anomalies") or {},
+           "txns": len(oks), "events": len(hist),
+           **({"error": str(res["error"])} if res.get("error")
+              else {}),
+           **({"coalesced": coalesced} if coalesced else {}),
+           "wall_s": round(time.monotonic() - t0, 3)}
+    if payload.get("certify", False):
+        try:
+            from ..analysis import certify
+            checks = []
+            diags = certify.certify_cycle_witness(
+                res, hist, workload=workload, opts=opts, checks=checks)
+            sev = {"error": 0, "warning": 0, "info": 0}
+            for d in diags:
+                sev[d.severity] = sev.get(d.severity, 0) + 1
+            out["certify"] = {
+                "certified": True,
+                "verdict": res.get("valid"),
+                "counts": sev,
+                "checks": checks,
+                "diagnostics": [{"code": d.code,
+                                 "severity": d.severity,
+                                 "message": d.message,
+                                 "location": d.location}
+                                for d in diags]}
+        except Exception:  # noqa: BLE001 - contained, never verdict-bearing
+            logger.warning("/api/check txn certification crashed",
+                           exc_info=True)
+            out["certify"] = {"certified": False,
+                              "error": "certification crashed"}
+    from .. import obs
+    obs.inc("fleet.api_checks", valid=str(out.get("valid")),
+            family="txn")
+    return out
+
+
 def _check_admitted(payload, hist, caller="local"):
     from ..analysis import histlint, errors as diag_errors
     from ..checker.checkers import Linearizable
     from ..models import model_spec
     from ..monitor import engine as mengine
+
+    family = payload.get("family")
+    if family == "txn":
+        return _check_txn_admitted(payload, hist, caller=caller)
+    if family is not None and family != "wgl":
+        raise ApiError(400, f"unknown check family {family!r}; "
+                            "known: wgl (default), txn")
 
     model = payload.get("model", "cas-register")
     try:

@@ -93,7 +93,11 @@ def run_cell_spec(spec):
     from .. import core, store
     from ..campaign import compile_cache
 
-    received_epoch = time.time()
+    # chaos clock skew (fleet.chaos "txn-skew"): this worker's wall
+    # clock reads skewed by a seeded offset; both handshake stamps
+    # shift together, exactly like a host with a wrong clock
+    skew_s = float(spec.get("clock-skew-s") or 0.0)
+    received_epoch = time.time() + skew_s
     cid = spec.get("cell")
     params = dict(spec.get("params") or {})
     tctx = spec.get("trace") or {}
@@ -171,7 +175,7 @@ def run_cell_spec(spec):
     except (AssertionError, AttributeError, KeyError, TypeError):
         rec["path"] = None
     rec["wall_s"] = round(time.monotonic() - t0, 3)
-    rec["clock"]["worker-result-epoch"] = time.time()
+    rec["clock"]["worker-result-epoch"] = time.time() + skew_s
     return rec
 
 

@@ -50,6 +50,8 @@ from __future__ import annotations
 from .core import (DEFAULT_CHUNK, Monitor, config, finalize,  # noqa: F401
                    find_linearizable, install)
 from .stream import StreamEncoder  # noqa: F401
+from .txn import TxnCheck, TxnMonitor  # noqa: F401
 
-__all__ = ["Monitor", "StreamEncoder", "install", "finalize", "config",
-           "find_linearizable", "DEFAULT_CHUNK"]
+__all__ = ["Monitor", "StreamEncoder", "TxnCheck", "TxnMonitor",
+           "install", "finalize", "config", "find_linearizable",
+           "DEFAULT_CHUNK"]

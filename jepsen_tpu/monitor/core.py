@@ -464,6 +464,11 @@ def install(test):
     cfg = config(test)
     if cfg is None:
         return None
+    if cfg.get("family") == "txn":
+        # transactional family: no linearizable gate to discover; the
+        # cycle engine's incremental frontier is the streaming check
+        from . import txn as mtxn
+        return mtxn.install_txn(test, cfg)
     try:
         lin, keyed = find_linearizable(test.get("checker"))
         if lin is None:

@@ -27,10 +27,15 @@ import logging
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["ENGINES", "check_prefix"]
+__all__ = ["ENGINES", "TXN_WORKLOADS", "check_prefix",
+           "check_txn_prefix"]
 
 #: engines the monitor can drive (planlint PL013 validates against it)
 ENGINES = ("jax-wgl", "linear", "wgl")
+
+#: txn-family workloads the monitor can stream (monitor/txn.py;
+#: planlint PL025 validates against it)
+TXN_WORKLOADS = ("append", "wr")
 
 #: CPU-engine budgets: chunk checks repeat, so each one must stay small
 LINEAR_MAX_CONFIGS = 200_000
@@ -66,3 +71,30 @@ def check_prefix(spec, e, init_state, engine="jax-wgl",
     except Exception as exc:  # noqa: BLE001 - contained per check
         logger.warning("monitor prefix check crashed", exc_info=True)
         return {"valid": "unknown", "error": repr(exc), "engine": engine}
+
+
+def check_txn_prefix(history, workload="append", opts=None, cancel=None):
+    """family="txn" dispatch: run the full offline ``cycle/`` analysis
+    over a consumed txn prefix -- the verdict of record the streaming
+    frontier's suspicion defers to (monitor/txn.py only calls this when
+    the incremental closure closed a cycle or inference flagged an
+    anomaly). Same containment as ``check_prefix``: exceptions become
+    "unknown", never an abort."""
+    opts = dict(opts or {})
+    try:
+        if workload == "wr":
+            from ..cycle import wr
+            return wr.analyze(list(history), opts)
+        from ..cycle import DEFAULT_ANOMALIES
+        from ..cycle import append as app
+        return app.analyze(
+            list(history),
+            tuple(opts.get("anomalies", DEFAULT_ANOMALIES)),
+            realtime=opts.get("realtime", True),
+            process=opts.get("process", False),
+            skew_bound=opts.get("skew-bound",
+                                opts.get("skew_bound", 0)))
+    except Exception as exc:  # noqa: BLE001 - contained per check
+        logger.warning("monitor txn prefix check crashed", exc_info=True)
+        return {"valid": "unknown", "error": repr(exc),
+                "engine": f"txn-{workload}"}

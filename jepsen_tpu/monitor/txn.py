@@ -1,0 +1,333 @@
+"""Streaming transactional (cycle-family) monitor: family="txn".
+
+The WGL monitor re-proves linearizability per chunk; the txn family's
+equivalent would be a from-scratch O(N^3 log N) transitive closure per
+chunk. Instead the monitor keeps the encoded adjacency matrix and its
+closure *frontier* resident across chunks (`cycle.IncrementalClosure`,
+device-resident above the host threshold): folding a chunk of newly
+committed txns in is one row/col delta OR plus a couple of squaring
+passes back to fixpoint -- the incremental-frontier formulation of
+arxiv 2410.04581 applied to reachability instead of linearizations.
+
+Verdict semantics mirror the WGL monitor's prefix contract:
+
+* a chunk with NO closed cycle in the frontier and NO inference-level
+  anomaly is exactly what the offline ``cycle/`` check would call valid
+  on the same cut (every Adya class needs a cycle; the inference-level
+  classes -- duplicates, incompatible-order, G1a, G1b, ... -- all land
+  in ``infer``'s found map), so the monitor answers True without ever
+  classifying;
+* suspicion (a closed cycle, or any inference anomaly) defers to the
+  full offline analysis (`engine.check_txn_prefix`) -- so False
+  verdicts, witnesses, and anomaly names are ALWAYS the offline
+  engine's. A cycle outside the requested anomaly classes leaves the
+  verdict True and the suspicion standing (documented cost, never a
+  verdict change);
+* garbage reads alone are "unknown", counted, never aborting.
+
+The first False flips the same ChainedLatch (reason
+``monitor-violation``) as the WGL monitor; the acceptance property is
+verdict equivalence with the offline check at chunks 1/8/64, with
+per-chunk closure cost asserted by counting squaring passes
+(`cycle.closure_passes`), not wall clock.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time as _time
+
+from .. import obs
+from .. import robust
+from ..cycle import DEFAULT_ANOMALIES, IncrementalClosure
+from . import engine as mengine
+from .core import ABORT_REASON, CANCEL_JOIN_S, DEFAULT_CHUNK, STOP_JOIN_S
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["TxnCheck", "TxnMonitor", "install_txn"]
+
+
+class TxnCheck:
+    """Synchronous chunk-check core: consume ops, maintain the
+    incremental frontier, answer offline-equivalent verdicts per
+    chunk. Thread-free so equivalence tests drive chunks 1/8/64
+    deterministically; `TxnMonitor` wraps it in the monitor-thread
+    contract."""
+
+    def __init__(self, workload="append", anomalies=None, realtime=True,
+                 process=False, skew_bound=0, lo=64):
+        if workload not in mengine.TXN_WORKLOADS:
+            raise ValueError(f"unknown txn workload {workload!r}; "
+                             f"expected one of {mengine.TXN_WORKLOADS}")
+        self.workload = workload
+        self.anomalies = tuple(anomalies or DEFAULT_ANOMALIES)
+        self.realtime = bool(realtime)
+        self.process = bool(process)
+        self.skew_bound = int(skew_bound or 0)
+        self.frontier = IncrementalClosure(lo=lo)
+        self._hist = []
+        self.n_txns = 0
+
+    def _opts(self):
+        return {"anomalies": self.anomalies, "realtime": self.realtime,
+                "process": self.process, "skew-bound": self.skew_bound}
+
+    def offer(self, op):
+        """Append one history event (invokes included: realtime edges
+        need invocation times)."""
+        self._hist.append(op)
+
+    def _infer(self):
+        from ..cycle import append as cycle_append
+        from ..cycle import wr as cycle_wr
+        if self.workload == "wr":
+            return cycle_wr.infer(self._hist, self._opts())
+        graph, found, oks = cycle_append.infer(
+            self._hist, self.anomalies, self.realtime, self.process,
+            self.skew_bound)
+        return graph, found, oks, found.get("garbage-read") or []
+
+    def check(self, cancel=None):
+        """One chunk check over the consumed prefix. Returns the
+        offline-shaped verdict dict for this cut."""
+        graph, found, oks, garbage = self._infer()
+        self.n_txns = len(oks)
+        self.frontier.update(graph.adj > 0)
+        suspicious = set(found) - {"garbage-read"}
+        if suspicious or self.frontier.has_cycle():
+            # the offline engine owns every False: witness, anomaly
+            # names, and the requested-subset semantics all come from
+            # the same code path the final checker runs
+            return mengine.check_txn_prefix(
+                self._hist, self.workload, self._opts(), cancel=cancel)
+        if garbage:
+            return {"valid": "unknown", "anomaly_types": [],
+                    "anomalies": {"garbage-read": garbage}}
+        return {"valid": True, "anomaly_types": [], "anomalies": {}}
+
+    @property
+    def history(self):
+        return self._hist
+
+
+class TxnMonitor:
+    """One run's streaming txn monitor: the WGL `Monitor`'s threading
+    contract (O(1) offer on the event-loop thread, one daemon chunk
+    thread, bounded idempotent stop) over a `TxnCheck` core."""
+
+    family = "txn"
+    #: finalize() parks evidence as dict(evidence, spec=mon.spec);
+    #: the txn family has no WGL spec
+    spec = None
+
+    def __init__(self, latch, chunk=DEFAULT_CHUNK, workload="append",
+                 anomalies=None, realtime=True, process=False,
+                 skew_bound=0):
+        self.latch = latch
+        self.chunk = max(1, int(chunk))
+        self.core = TxnCheck(workload=workload, anomalies=anomalies,
+                             realtime=realtime, process=process,
+                             skew_bound=skew_bound)
+        self.engine = f"txn-{workload}"
+        self.violation = None
+        self.evidence = None
+        self._tr, self._reg = obs.current_sinks()
+        self._cancel = threading.Event()
+        self._cond = threading.Condition()
+        self._queue = collections.deque()
+        self._pending_completions = 0
+        self._n_seen = 0
+        self._stopping = False
+        self._finish = True
+        self._last_verdict = True
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="jepsen txn monitor")
+        self.ops_consumed = 0
+        self.chunks = 0
+        self.checks = 0
+        self.unknown_checks = 0
+        self._t_start = _time.monotonic()
+        self._t_first_verdict = None
+
+    # -- interpreter side --------------------------------------------------
+
+    def offer(self, op):
+        """Op-sink entry: O(1); never raises."""
+        try:
+            with self._cond:
+                idx = self._n_seen
+                self._n_seen += 1
+                if self.violation is not None or self._stopping:
+                    return
+                self._queue.append((op, idx, _time.monotonic()))
+                if op.get("type") != "invoke" \
+                        and isinstance(op.get("process"), int):
+                    self._pending_completions += 1
+                    if self._pending_completions >= self.chunk:
+                        self._cond.notify()
+        except Exception:  # noqa: BLE001 - must never hurt the run
+            logger.warning("txn monitor offer failed", exc_info=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self, finish=True, timeout_s=STOP_JOIN_S):
+        with self._cond:
+            self._stopping = True
+            self._finish = self._finish and finish
+            self._cond.notify_all()
+        if not self._thread.is_alive():
+            return
+        self._thread.join(timeout_s)
+        if self._thread.is_alive():
+            self._cancel.set()
+            self._thread.join(CANCEL_JOIN_S)
+            if self._thread.is_alive():
+                logger.warning("txn monitor thread did not exit; "
+                               "abandoning")
+                self._inc("robust.leaked_threads")
+
+    # -- summary -----------------------------------------------------------
+
+    def summary(self):
+        """The ``results["monitor"]`` block."""
+        verdict = False if self.violation is not None \
+            else self._last_verdict
+        out = {
+            "verdict": verdict,
+            "family": "txn",
+            "workload": self.core.workload,
+            "engine": self.engine,
+            "chunk": self.chunk,
+            "ops_consumed": self.ops_consumed,
+            "chunks": self.chunks,
+            "checks": self.checks,
+            "unknown_checks": self.unknown_checks,
+            "txns": self.core.n_txns,
+            "closure_rebuilds": self.core.frontier.rebuilds,
+            "time_to_first_verdict_s": self._t_first_verdict,
+        }
+        if self.core.skew_bound:
+            out["skew_bound"] = self.core.skew_bound
+        if self.violation is not None:
+            out.update(self.violation)
+        return out
+
+    # -- monitor thread ----------------------------------------------------
+
+    def _inc(self, name, n=1, **labels):
+        if self._reg is not None:
+            self._reg.inc(name, n, **labels)
+
+    def _step(self, t_newest=None):
+        with self._cond:
+            batch = list(self._queue)
+            self._queue.clear()
+            self._pending_completions = 0
+        if not batch:
+            return
+        newest = 0.0
+        for op, idx, t in batch:
+            self.core.offer(op)
+            self.ops_consumed += 1
+            newest = max(newest, t)
+        self._inc("monitor.ops_consumed", len(batch))
+        self.chunks += 1
+        self._inc("monitor.chunks")
+        t0 = _time.monotonic()
+        res = self.core.check(cancel=self._cancel)
+        dt = _time.monotonic() - t0
+        self.checks += 1
+        valid = res.get("valid")
+        self._inc("monitor.checks", valid=str(valid))
+        if self._reg is not None:
+            self._reg.observe("monitor.check_s", dt)
+        if self._t_first_verdict is None and valid in (True, False):
+            self._t_first_verdict = round(
+                _time.monotonic() - self._t_start, 4)
+            if self._reg is not None:
+                self._reg.set_gauge("monitor.time_to_first_verdict_s",
+                                    self._t_first_verdict)
+        if valid == "unknown":
+            self.unknown_checks += 1
+            if self._last_verdict is not False:
+                self._last_verdict = "unknown"
+            return
+        self._last_verdict = valid
+        if valid is False and self.violation is None:
+            latency = max(0.0, _time.monotonic() - newest)
+            self.violation = {
+                "detected_at_index": self._n_seen - 1,
+                "detection_latency_s": round(latency, 4),
+                "checked_ops": len(self.core.history),
+                "anomaly_types": list(res.get("anomaly_types") or ()),
+            }
+            self.evidence = {
+                "family": "txn",
+                "workload": self.core.workload,
+                "opts": self.core._opts(),
+                "history": list(self.core.history),
+                "result": res,
+            }
+            self._inc("monitor.violations")
+            if self._reg is not None:
+                self._reg.set_gauge(
+                    "monitor.detection_latency_s",
+                    self.violation["detection_latency_s"])
+            if self._tr is not None:
+                self._tr.instant("monitor.violation", cat="monitor",
+                                 args=dict(self.violation))
+            logger.warning(
+                "MONITOR: txn anomaly %s detected at history index %d "
+                "(%.3fs after the op landed); aborting run",
+                ",".join(self.violation["anomaly_types"]) or "?",
+                self._n_seen - 1, latency)
+            self.latch.set(ABORT_REASON)
+
+    def _run(self):
+        with obs.sink_scope(self._tr, self._reg):
+            while True:
+                with self._cond:
+                    while (self._pending_completions < self.chunk
+                           and not self._stopping
+                           and self.violation is None):
+                        self._cond.wait(0.25)
+                    stopping = self._stopping
+                if self.violation is not None:
+                    break
+                if stopping:
+                    if self._finish and not self._cancel.is_set():
+                        self._step()
+                    break
+                self._step()
+
+
+def install_txn(test, cfg):
+    """Wire a TxnMonitor from a normalized monitor config with
+    ``family: "txn"`` (core.install dispatches here). Chains the run's
+    abort latch and subscribes to the op-sink list exactly like the WGL
+    path. Returns the started monitor, or None (never raises)."""
+    try:
+        latch = robust.ChainedLatch(test.get("abort"))
+        test["abort"] = latch
+        mon = TxnMonitor(
+            latch=latch,
+            chunk=cfg.get("chunk") or DEFAULT_CHUNK,
+            workload=cfg.get("workload", "append"),
+            anomalies=cfg.get("anomalies"),
+            realtime=cfg.get("realtime", True),
+            process=cfg.get("process", False),
+            skew_bound=cfg.get("skew-bound", cfg.get("skew_bound", 0)))
+        test.setdefault("op-sinks", []).append(mon.offer)
+        obs.inc("monitor.installed", engine=mon.engine)
+        return mon.start()
+    except Exception:  # noqa: BLE001 - a monitor bug must not kill runs
+        logger.warning("txn monitor install failed; continuing "
+                       "unmonitored", exc_info=True)
+        return None

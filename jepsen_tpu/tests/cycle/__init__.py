@@ -16,10 +16,26 @@ import random
 from ...checker.core import FnChecker
 
 
-def checker(analyze_fn, opts=None):
-    """A checker from a history->result analyzer (cycle.clj:9-16)."""
-    return FnChecker(lambda test, hist, _opts: analyze_fn(hist, opts),
-                     name=getattr(analyze_fn, "__module__", "cycle"))
+def checker(analyze_fn, opts=None, workload=None):
+    """A checker from a history->result analyzer (cycle.clj:9-16).
+    Decided verdicts get the cycle-witness certification ride-along
+    (analysis/certify.py VC013): every implicated cycle replayed
+    host-side through the same inference, persisted in
+    certificate.json. Contained -- never flips a verdict."""
+    name = getattr(analyze_fn, "__module__", "cycle")
+    wl = workload or ("wr" if name.endswith(".wr") else "append")
+
+    def run(test, hist, _opts):
+        res = analyze_fn(hist, opts)
+        try:
+            from ...analysis import certify
+            certify.certify_txn_verdict(test, hist, res, workload=wl,
+                                        opts=opts)
+        except Exception:  # noqa: BLE001 - certification is contained
+            pass
+        return res
+
+    return FnChecker(run, name=name)
 
 
 def txn_generator(key_count=3, min_txn_length=1, max_txn_length=4,

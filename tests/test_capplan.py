@@ -118,8 +118,11 @@ def test_cp001_unknown_workload_and_runtime_bound():
 
 
 def test_known_empty_workloads_are_not_unknown():
+    # "append" left this list when the txn family registered real
+    # closure shapes for it (its no-params case is UnknownShape,
+    # covered by test_txn_service.test_capplan_txn_shapes)
     plan, diags = capplan.build_plan(
-        {"axes": {"workload": ["noop", "bank", "set", "append"]}})
+        {"axes": {"workload": ["noop", "bank", "set"]}})
     assert plan["unknown_cells"] == 0
     assert plan["compiles"]["distinct"] == 0
     assert "CP001" not in codes(diags)

@@ -1,0 +1,255 @@
+"""Streaming transactional monitor (monitor/txn.py): incremental
+verdict equivalence with the offline cycle/ engine across the Adya
+taxonomy at chunks 1/8/64, closure-pass cost accounting (the
+incrementality contract is asserted by counting squaring passes, not
+wall clock), skew-aware RT inference, and the monitor-thread abort
+loop."""
+
+import time
+
+import pytest
+
+from jepsen_tpu import cycle, history as hh, monitor as jmonitor
+from jepsen_tpu.cycle import (DEFAULT_ANOMALIES, PROCESS_ANOMALIES,
+                              skew_bound_from_offsets)
+from jepsen_tpu.monitor import engine as mengine
+from jepsen_tpu.monitor import txn as txnmon
+
+
+def P(*txns):
+    """Paired invoke/ok history from (inv_time, ok_time, mops[, proc])
+    tuples; the process defaults to the txn's position."""
+    out = []
+    for i, tx in enumerate(txns):
+        t0, t1, mops = tx[:3]
+        proc = tx[3] if len(tx) > 3 else i
+        out.append({"type": "invoke", "f": "txn", "process": proc,
+                    "time": t0, "value": mops})
+        out.append({"type": "ok", "f": "txn", "process": proc,
+                    "time": t1, "value": mops})
+    return hh.index(out)
+
+
+def OV(*txns):
+    """Fully-overlapping paired txns (staggered invokes, completions
+    all far out): no RT edge can arise, so the plain Adya classes
+    classify un-shadowed by their -realtime variants. ``txns`` entries
+    are mop-lists or (mops, proc) pairs."""
+    out = []
+    for i, tx in enumerate(txns):
+        if isinstance(tx, tuple):
+            mops, proc = tx
+        else:
+            mops, proc = tx, i
+        out.append((i * 10, 1000 + i, mops, proc))
+    return P(*out)
+
+
+A = lambda k, v: ["append", k, v]    # noqa: E731 - fixture shorthand
+R = lambda k, v: ["r", k, v]         # noqa: E731
+
+
+def _fixtures():
+    """(name, history, expected_valid, expected_class, txncheck_kwargs)
+    covering valid + G0/G1c/G-single/G2 and the -realtime / -process
+    variant of each."""
+    proc_kw = {"anomalies": tuple(DEFAULT_ANOMALIES)
+               + tuple(PROCESS_ANOMALIES), "process": True}
+    return [
+        ("valid",
+         P((0, 10, [A("x", 1)]), (20, 30, [A("x", 2)]),
+           (40, 50, [R("x", [1, 2])])),
+         True, None, {}),
+        # -- plain classes: every interval overlaps, so the cycle is
+        #    closed purely by dependency edges
+        ("G0",
+         OV([A("x", 1), A("y", 1)], [A("x", 2), A("y", 2)],
+            [R("x", [1, 2]), R("y", [2, 1])]),
+         False, "G0", {}),
+        ("G1c",
+         OV([R("y", [1]), A("x", 1)], [R("x", [1]), A("y", 1)]),
+         False, "G1c", {}),
+        ("G-single",
+         OV([A("x", 1), A("y", 1)], [R("x", []), R("y", [1])],
+            [R("x", [1])]),
+         False, "G-single", {}),
+        ("G2",
+         OV([R("x", []), A("y", 1)], [R("y", []), A("x", 1)],
+            [R("x", [1]), R("y", [1])]),
+         False, "G2", {}),
+        # -- realtime variants: one leg of the cycle is an RT edge
+        ("G0-realtime",
+         P((0, 10, [A("x", 1)]), (20, 30, [A("x", 2)]),
+           (40, 50, [R("x", [2, 1])])),
+         False, "G0-realtime", {}),
+        ("G1c-realtime",
+         P((0, 10, [R("x", [2])]), (20, 30, [A("x", 2)])),
+         False, "G1c-realtime", {}),
+        ("G-single-realtime",
+         P((0, 10, [A("x", 1)]), (20, 30, [A("x", 2)]),
+           (40, 50, [R("x", [1])]), (60, 70, [R("x", [1, 2])])),
+         False, "G-single-realtime", {}),
+        ("G2-realtime",
+         P((0, 100, [R("z", []), A("y", 1)]),
+           (90, 200, [R("y", []), A("x", 1)]),
+           (150, 160, [R("x", [])]),
+           (300, 310, [R("x", [1]), R("y", [1])])),
+         False, "G2-realtime", {}),
+        # -- process variants: the realtime leg is replaced by a
+        #    same-process program-order edge; intervals all overlap
+        ("G0-process",
+         OV(([A("x", 1)], 5), ([A("x", 2)], 5), ([R("x", [2, 1])], 9)),
+         False, "G0-process", proc_kw),
+        ("G1c-process",
+         OV(([R("x", [2])], 5), ([A("x", 2)], 5)),
+         False, "G1c-process", proc_kw),
+        ("G-single-process",
+         OV(([A("x", 1)], 1), ([A("x", 2)], 5), ([R("x", [1])], 5),
+            ([R("x", [1, 2])], 7)),
+         False, "G-single-process", proc_kw),
+        ("G2-process",
+         OV(([R("z", []), A("y", 1)], 5), ([R("y", []), A("x", 1)], 1),
+            ([R("x", [])], 5), ([R("x", [1]), R("y", [1])], 7)),
+         False, "G2-process", proc_kw),
+    ]
+
+
+def _drive(hist, chunk, **kw):
+    """Feed the event stream through a TxnCheck in ``chunk``-event
+    slices, asserting each cut's verdict equals the offline engine's on
+    the same prefix. Returns the final verdict."""
+    core = txnmon.TxnCheck(workload=kw.pop("workload", "append"), **kw)
+    res = None
+    for i, op in enumerate(hist):
+        core.offer(op)
+        if (i + 1) % chunk == 0 or i == len(hist) - 1:
+            res = core.check()
+            off = mengine.check_txn_prefix(hist[:i + 1], core.workload,
+                                           core._opts())
+            assert res["valid"] == off["valid"], \
+                (i, chunk, res, off)
+            if res["valid"] is False:
+                assert res["anomaly_types"] == off["anomaly_types"], \
+                    (i, chunk, res, off)
+    return res
+
+
+@pytest.mark.parametrize("chunk", [1, 8, 64])
+def test_incremental_matches_offline_across_taxonomy(chunk):
+    """THE acceptance gate: streaming verdict == offline verdict on
+    every taxonomy-class fixture, at every chunking."""
+    for name, hist, want_valid, want_class, kw in _fixtures():
+        res = _drive(hist, chunk, **dict(kw))
+        assert res["valid"] is want_valid, (name, chunk, res)
+        if want_class is not None:
+            assert want_class in res["anomaly_types"], \
+                (name, chunk, res["anomaly_types"])
+
+
+def test_garbage_read_is_unknown_and_never_false():
+    hist = P((0, 10, [R("x", [5])]))
+    for chunk in (1, 8):
+        res = _drive(hist, chunk)
+        assert res["valid"] == "unknown"
+
+
+def test_incremental_cost_counts_closure_passes_not_rebuilds():
+    """The incrementality contract: after the frontier is seeded, each
+    single-txn chunk costs a handful of squaring passes (row/col delta
+    OR + re-fixpoint), NOT a from-scratch closure -- and nothing close
+    to one O(n^3 log n) rebuild per chunk."""
+    n = 48
+    txns = [(i * 10, i * 10 + 5, [A("x", i + 1)]) for i in range(n)]
+    txns.append((n * 10, n * 10 + 5, [R("x", list(range(1, n + 1)))]))
+    hist = P(*txns)
+    core = txnmon.TxnCheck()
+    deltas = []
+    for op in hist:
+        core.offer(op)
+        if op.get("type") == "ok":
+            before = cycle.closure_passes()
+            res = core.check()
+            deltas.append(cycle.closure_passes() - before)
+            assert res["valid"] is True
+    # every post-seed chunk: delta OR + squaring back to fixpoint
+    assert max(deltas[1:]) <= 4, deltas
+    # n stays under the lo=64 pad, so the frontier is rebuilt exactly
+    # once (the seeding) over the whole run
+    assert core.frontier.rebuilds == 1
+    # and the total is far under one from-scratch closure per chunk
+    scratch = len(deltas) * max(1, int(__import__("math").ceil(
+        __import__("math").log2(64))))
+    assert sum(deltas) < scratch
+
+
+def test_skewed_worker_does_not_fabricate_rt_edges():
+    """A worker whose clock ran 30s slow makes T0's completion *appear*
+    30s before T1's invocation. With the recovered offset bound
+    injected, the RT edge must be refused; without it, the same history
+    is a G1c-realtime violation."""
+    hist = P((0, 10_000_000_000, [R("x", [2])]),
+             (40_000_000_000, 50_000_000_000, [A("x", 2)]))
+    bound = skew_bound_from_offsets([-30.0, 0.5], 1e9)
+    assert bound == 30_500_000_000
+    for chunk in (1, 8):
+        res = _drive(hist, chunk, skew_bound=bound)
+        assert res["valid"] is True, res
+    res = _drive(hist, 8)
+    assert res["valid"] is False
+    assert "G1c-realtime" in res["anomaly_types"]
+
+
+def test_skew_bound_only_suppresses_within_bound_gaps():
+    """A gap beyond the bound still infers RT: the bound must not
+    disable strict serializability wholesale."""
+    hist = P((0, 10_000_000_000, [R("x", [2])]),
+             (90_000_000_000, 95_000_000_000, [A("x", 2)]))
+    res = _drive(hist, 8, skew_bound=30_500_000_000)
+    assert res["valid"] is False
+    assert "G1c-realtime" in res["anomaly_types"]
+
+
+def test_txn_monitor_thread_flips_latch_on_violation():
+    test = {}
+    mon = txnmon.install_txn(test, {"chunk": 2, "workload": "append"})
+    assert mon is not None
+    try:
+        for op in P((0, 10, [R("x", [2])]), (20, 30, [A("x", 2)])):
+            mon.offer(op)
+        deadline = time.monotonic() + 15
+        while mon.violation is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert mon.violation is not None, "monitor never detected"
+        assert test["abort"].is_set()
+        from jepsen_tpu.monitor.core import ABORT_REASON
+        assert test["abort"].reason == ABORT_REASON
+        assert "G1c-realtime" in mon.violation["anomaly_types"]
+        s = mon.summary()
+        assert s["verdict"] is False and s["family"] == "txn"
+        assert s["engine"] == "txn-append"
+        assert s["txns"] >= 1 and s["chunks"] >= 1
+    finally:
+        mon.stop()
+
+
+def test_txn_monitor_clean_run_summary():
+    test = {"monitor": {"family": "txn", "workload": "append",
+                        "chunk": 2}}
+    mon = jmonitor.install(test)      # core dispatch on family
+    assert isinstance(mon, txnmon.TxnMonitor)
+    try:
+        for op in P((0, 10, [A("x", 1)]), (20, 30, [R("x", [1])])):
+            mon.offer(op)
+        deadline = time.monotonic() + 15
+        while mon.checks == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        mon.stop()
+    s = mon.summary()
+    assert s["verdict"] is True and s["family"] == "txn"
+    assert s["ops_consumed"] == 4 and mon.violation is None
+
+
+def test_txncheck_rejects_unknown_workload():
+    with pytest.raises(ValueError):
+        txnmon.TxnCheck(workload="nope")

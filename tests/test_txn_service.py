@@ -1,0 +1,250 @@
+"""Transactional family through the service stack: POST /api/check
+``family: "txn"`` dispatch (validation, verdicts, certification),
+coalesced multi-tenant txn batches through the cross-tenant batcher,
+capplan's closure-shape registry, the txn-skew chaos profile, and the
+PL025 planlint rules."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import store
+from jepsen_tpu.analysis import capplan, planlint, sizemodel
+from jepsen_tpu.campaign import compile_cache
+from jepsen_tpu.fleet import chaos, service
+
+
+@pytest.fixture(autouse=True)
+def service_state(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+    compile_cache.reset()
+    service.reset()
+    yield
+    service.reset()
+    compile_cache.reset()
+
+
+def txn_hist(kind="valid"):
+    """Paired append-workload event streams. ``g1c-realtime``: a read
+    observes a strictly-later txn's append (serializable, not strictly
+    so)."""
+    def pair(t0, t1, proc, mops):
+        return [{"type": "invoke", "f": "txn", "process": proc,
+                 "time": t0, "value": mops},
+                {"type": "ok", "f": "txn", "process": proc,
+                 "time": t1, "value": mops}]
+    if kind == "g1c-realtime":
+        return (pair(0, 10, 0, [["r", "x", [2]]])
+                + pair(20, 30, 1, [["append", "x", 2]]))
+    out = pair(0, 10, 0, [["append", "x", 1]])
+    out += pair(20, 30, 1, [["append", "x", 2]])
+    out += pair(40, 50, 2, [["r", "x", [1, 2]]])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# /api/check family dispatch
+
+def test_family_txn_valid_append():
+    res = service.check_history({"family": "txn", "history": txn_hist(),
+                                 "workload": "append"})
+    assert res["valid"] is True
+    assert res["family"] == "txn" and res["model"] == "txn-append"
+    assert res["txns"] == 3 and res["anomaly_types"] == []
+
+
+def test_family_txn_g1c_realtime_with_certificate():
+    res = service.check_history(
+        {"family": "txn", "history": txn_hist("g1c-realtime"),
+         "workload": "append", "certify": True})
+    assert res["valid"] is False
+    assert "G1c-realtime" in res["anomaly_types"]
+    cert = res["certify"]
+    assert cert["certified"] is True
+    assert cert["verdict"] is False
+
+
+def test_family_txn_wr_workload():
+    hist = [{"type": "invoke", "f": "txn", "process": 0, "time": 0,
+             "value": [["w", "x", 1]]},
+            {"type": "ok", "f": "txn", "process": 0, "time": 10,
+             "value": [["w", "x", 1]]},
+            {"type": "invoke", "f": "txn", "process": 1, "time": 20,
+             "value": [["r", "x", 1]]},
+            {"type": "ok", "f": "txn", "process": 1, "time": 30,
+             "value": [["r", "x", 1]]}]
+    res = service.check_history({"family": "txn", "history": hist,
+                                 "workload": "wr"})
+    assert res["valid"] is True and res["model"] == "txn-wr"
+
+
+def test_family_txn_skew_bound_suppresses_rt_edge():
+    hist = txn_hist("g1c-realtime")
+    # the 10-tick gap sits inside a 100-tick recovered offset bound
+    res = service.check_history(
+        {"family": "txn", "history": hist, "workload": "append",
+         "skew-bound": 100})
+    assert res["valid"] is True, res
+
+
+def test_family_dispatch_validation():
+    with pytest.raises(service.ApiError) as e:
+        service.check_history({"family": "txn", "history": txn_hist(),
+                               "workload": "nope"})
+    assert e.value.status == 400
+    with pytest.raises(service.ApiError) as e:
+        service.check_history({"family": "txn", "history": txn_hist(),
+                               "anomalies": ["G9"]})
+    assert e.value.status == 400
+    with pytest.raises(service.ApiError) as e:
+        service.check_history({"family": "bogus",
+                               "history": txn_hist()})
+    assert e.value.status == 400
+
+
+# ---------------------------------------------------------------------------
+# coalesced multi-tenant txn batches
+
+def test_coalesced_txn_tenants_match_solo():
+    """Multi-tenant gate: concurrent txn submissions coalesce into one
+    batched closure probe and get exactly the solo verdicts."""
+    payloads = [
+        {"family": "txn", "history": txn_hist(), "workload": "append"},
+        {"family": "txn", "history": txn_hist("g1c-realtime"),
+         "workload": "append"},
+        {"family": "txn", "history": txn_hist(), "workload": "append"},
+    ]
+    solo = [service.check_history({**p, "coalesce": False},
+                                  caller=f"solo-{i}")
+            for i, p in enumerate(payloads)]
+    service.configure_coalesce(enabled=True, window_ms=200)
+    results = [None] * len(payloads)
+
+    def call(i):
+        results[i] = service.check_history(payloads[i],
+                                           caller=f"tenant-{i}")
+
+    threads = [threading.Thread(target=call, args=(i,))
+               for i in range(len(payloads))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert all(r is not None for r in results)
+    assert [r["valid"] for r in results] == \
+        [r["valid"] for r in solo] == [True, False, True]
+    # the acyclic tenants really went through the batcher
+    assert any("coalesced" in r for r in results)
+    st = service.coalescer().stats()
+    assert st["batches"] >= 1 and st["segments"] >= 2
+
+
+def test_coalescer_preregisters_predicted_txn_shapes():
+    plan, _diags = capplan.build_plan(
+        {"base": {"workload": "append", "txn-count": 300},
+         "axes": {"seed": [0]}})
+    keys = capplan.predicted_keys(plan)
+    assert ("txn-closure", 512) in keys
+    service.configure_coalesce(enabled=True, window_ms=50)
+    service.coalescer().preregister(keys)
+
+
+# ---------------------------------------------------------------------------
+# capplan closure shapes
+
+def test_capplan_txn_shapes():
+    shapes = capplan.shapes_for_cell({"workload": "append",
+                                      "txn-count": 300})
+    assert len(shapes) == 1
+    s = shapes[0]
+    assert s["engine"] == "txn-closure" and s["bucket"] == 512
+    assert s["hbm"]["total"] > 0 and s["passes"] == 9
+    # derivable from rate * time-limit * concurrency when txn-count
+    # is not pinned
+    shapes = capplan.shapes_for_cell({"workload": "wr", "time-limit": 5,
+                                      "rate": 100, "concurrency": 3})
+    assert shapes[0]["n_ops"] == 1650
+    with pytest.raises(capplan.UnknownShape):
+        capplan.shapes_for_cell({"workload": "append"})
+
+
+def test_closure_shape_buckets_and_int32():
+    s = sizemodel.closure_shape(3)
+    assert s["bucket"] == 64                 # the device floor
+    s = sizemodel.closure_shape(100_000)
+    assert s["bucket"] == 131072
+    assert s["int32"]["frac"] > 1            # past the int32 wall...
+    assert s["hbm"]["total"] > 100 * 2 ** 30  # ...and HBM says no first
+
+
+# ---------------------------------------------------------------------------
+# txn-skew chaos profile
+
+def test_txn_skew_profile_is_deterministic_and_bounded():
+    prof = chaos.parse("txn-skew:7")
+    offs = [prof.skew_for(f"w{i}") for i in range(3)]
+    assert offs == [prof.skew_for(f"w{i}") for i in range(3)]
+    assert all(abs(o) <= prof.clock_skew_max_s for o in offs)
+    assert any(o != 0.0 for o in offs)
+    assert prof.skew_bound_s() == 2 * prof.clock_skew_max_s
+    # profiles without the skew knobs stay skew-free
+    soak = chaos.parse("soak:7")
+    assert soak.skew_for("w0") == 0.0 and soak.skew_bound_s() == 0.0
+
+
+def test_dispatch_stamps_skew_into_cell_spec():
+    from jepsen_tpu.fleet import worker as fworker
+    prof = chaos.parse("txn-skew:7")
+    skew = prof.skew_for("w0")
+    assert skew != 0.0
+    import time as _t
+    rec = fworker.run_cell_spec({
+        "cell-id": "c0", "builder": "jepsen_tpu.demo:demo_test",
+        "params": {}, "dry-run": True, "clock-skew-s": skew})
+    got = rec["clock"]["worker-result-epoch"] - _t.time()
+    assert abs(got - skew) < 5.0
+
+
+# ---------------------------------------------------------------------------
+# planlint PL013 refinement + PL025
+
+def test_pl013_skipped_for_txn_family():
+    from jepsen_tpu.tests.cycle import append as ap_wl
+    w = ap_wl.test({"key-count": 3})
+    t = {"checker": w["checker"],
+         "monitor": {"family": "txn", "workload": "append"}}
+    codes = {d.code for d in planlint.monitor_diags(t)}
+    assert "PL013" not in codes and "PL025" not in codes
+    # without the family, the no-linearizable-gate warning still fires
+    codes = {d.code for d in planlint.monitor_diags(
+        {"checker": w["checker"], "monitor": True})}
+    assert "PL013" in codes
+
+
+def test_pl025_txn_knob_validation():
+    bad = {"monitor": {"family": "txn", "workload": "nope",
+                       "anomalies": ["G1c", "G9", "G0-process"],
+                       "realtime": False, "skew-bound": -5}}
+    diags = planlint.monitor_diags(bad)
+    msgs = [d.message for d in diags if d.code == "PL025"]
+    assert any("unknown txn workload" in m for m in msgs)
+    assert any("G9" in m for m in msgs)
+    assert any("process edge inference is off" in m for m in msgs)
+    errors = [d for d in diags
+              if d.code == "PL025" and d.severity == "error"]
+    assert len(errors) == 3
+    # realtime off while -realtime classes requested
+    diags = planlint.monitor_diags(
+        {"monitor": {"family": "txn", "anomalies": ["G1c-realtime"],
+                     "realtime": False}})
+    assert any(d.code == "PL025" and d.severity == "error"
+               for d in diags)
+
+
+def test_pl025_register_model_under_txn_family():
+    from jepsen_tpu.checker import checkers as cc
+    t = {"checker": cc.linearizable({"model": "cas-register"}),
+         "monitor": {"family": "txn"}}
+    diags = planlint.monitor_diags(t)
+    assert any(d.code == "PL025" and "Linearizable" in d.message
+               for d in diags)

@@ -196,6 +196,195 @@ def _monitor_rung(n_ops=512, violate_at=256, chunk=64):
         return {"error": repr(exc)}
 
 
+def _stream_monitor_rung(n_streams=100, rounds=24, chunk=8,
+                         violate_every=10):
+    """Device-resident frontier monitoring at fleet width (rung 16,
+    checker/streamlin + monitor/wgl_stream): drive ``n_streams``
+    concurrent monitored cas-register streams, every ``violate_every``-th
+    one carrying an injected stale read at the half-way round, in two
+    modes --
+
+      off  the pre-streamlin behavior: per-chunk FLAT re-search of the
+           whole materialized prefix (mengine.check_prefix, jax-wgl)
+      on   StreamCheck frontiers with the service Coalescer up, so
+           strangers' frontier folds share padded (model, bucket)
+           device batches
+
+    and report sustained monitored-ops/s per mode, detection latency
+    p50/p99 across the violating streams (violating op offered ->
+    check proves False), the device duty cycle from the
+    ``wgl.device_busy_s`` counter over each mode's wall (the PR 13
+    metrics plane), per-chunk fold cost from the stream counters (the
+    observable O(window) claim), and the coalescer's batch/segment/
+    owners evidence (acceptance: batches > 0 with owners >= 2).
+    Self-contained and never fatal."""
+    import threading as _threading
+
+    try:
+        from jepsen_tpu import obs
+        from jepsen_tpu.fleet import service
+        from jepsen_tpu.models import model_spec
+        from jepsen_tpu.monitor import engine as _mengine
+        from jepsen_tpu.monitor.stream import StreamEncoder
+        from jepsen_tpu.monitor.wgl_stream import StreamCheck
+
+        spec = model_spec("cas-register")
+
+        def reg_busy():
+            reg = obs.registry()
+            if reg is None:
+                return 0.0
+            return sum(v for k, v in
+                       reg.snapshot()["counters"].items()
+                       if k.startswith("wgl.device_busy_s"))
+
+        def stream_ops(s, bad_round):
+            ops, val = [], None
+            for j in range(rounds):
+                val = j + 1
+                ops.append(({"type": "invoke", "process": 0,
+                             "f": "write", "value": val}, None))
+                ops.append(({"type": "ok", "process": 0,
+                             "f": "write", "value": val}, None))
+                rv = 10**6 if j == bad_round else val
+                ops.append(({"type": "invoke", "process": 0,
+                             "f": "read", "value": None}, None))
+                ops.append(({"type": "ok", "process": 0,
+                             "f": "read", "value": rv},
+                            "violate" if j == bad_round else None))
+            return ops
+
+        def drive(mode):
+            done = [0] * n_streams
+            detect = {}
+            streams_sc = []
+            lock = _threading.Lock()
+
+            def one(s):
+                bad = rounds // 2 if s % violate_every == 0 else None
+                if mode == "on":
+                    sc = StreamCheck(spec, owner=f"bench-{s}")
+                    with lock:
+                        streams_sc.append(sc)
+                else:
+                    sc = StreamEncoder(spec)
+                t_bad = None
+                n = 0
+                for i, (op, mark) in enumerate(stream_ops(s, bad)):
+                    offered = sc.offer(op, i)
+                    done[s] += 1
+                    if mark == "violate":
+                        t_bad = time.monotonic()
+                    if offered:
+                        n += 1
+                        if n % chunk == 0 or mark == "violate":
+                            if mode == "on":
+                                r = sc.check()
+                            else:
+                                e, st = sc.materialize()
+                                r = _mengine.check_prefix(
+                                    spec, e, st, engine="jax-wgl")
+                            if r["valid"] is False:
+                                if t_bad is not None:
+                                    with lock:
+                                        detect[s] = (time.monotonic()
+                                                     - t_bad)
+                                return
+
+            busy0 = reg_busy()
+            t0 = time.monotonic()
+            ths = [_threading.Thread(target=one, args=(s,))
+                   for s in range(n_streams)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            wall = time.monotonic() - t0
+            lat = sorted(detect.values())
+            out = {
+                "wall_s": round(wall, 2),
+                "ops": sum(done),
+                "ops_per_s": round(sum(done) / wall, 1) if wall else None,
+                "streams": n_streams,
+                "violating": n_streams // violate_every
+                + (1 if n_streams % violate_every else 0),
+                "detected": len(lat),
+                "detect_p50_ms": round(lat[len(lat) // 2] * 1e3, 1)
+                if lat else None,
+                "detect_p99_ms": round(
+                    lat[min(len(lat) - 1,
+                            int(len(lat) * 0.99))] * 1e3, 1)
+                if lat else None,
+                "device_busy_s": round(reg_busy() - busy0, 3),
+                "duty_cycle": round((reg_busy() - busy0) / wall, 4)
+                if wall else None,
+            }
+            if mode == "on":
+                folds = sum(sc.seal_folds + sc.probe_folds
+                            for sc in streams_sc)
+                cells = sum(sc.fold_cells for sc in streams_sc)
+                out.update({
+                    "folds": folds,
+                    "cells_per_fold": round(cells / folds, 1)
+                    if folds else None,
+                    "coalesced_folds": sum(sc.coalesced_folds
+                                           for sc in streams_sc),
+                    "solo_folds": sum(sc.solo_folds
+                                      for sc in streams_sc),
+                    "flat_fallbacks": sum(sc.flat_checks
+                                          for sc in streams_sc),
+                    "frontier_peak": max((sc.frontier_peak
+                                          for sc in streams_sc),
+                                         default=None),
+                    # widest batch any fold rode: each stream is its
+                    # own owner with one in-flight fold, so a batch of
+                    # K members is K distinct owners sharing a dispatch
+                    "batch_peak": max((sc.batch_peak
+                                       for sc in streams_sc),
+                                      default=1),
+                    "device_fold_s": round(sum(sc.device_s
+                                               for sc in streams_sc),
+                                           3),
+                })
+            return out
+
+        # OFF first (no coalescer), then ON with the batcher up
+        service.configure_coalesce(enabled=False)
+        off = drive("off")
+        service.configure_coalesce(enabled=True, window_ms=25)
+        try:
+            on = drive("on")
+            st = service.coalescer().stats()
+            on["batches"] = st["batches"]
+            on["segments"] = st["segments"]
+            reg = obs.registry()
+            owners_max = None
+            if reg is not None:
+                h = reg.snapshot().get("histograms", {}).get(
+                    "service.coalesce.owners")
+                if h:
+                    owners_max = h.get("max")
+            # registry histogram when a metrics plane is up; the
+            # stream-side batch_peak is the registry-free evidence
+            # (each stream = one owner with one in-flight fold)
+            on["owners_max"] = owners_max or on.get("batch_peak")
+        finally:
+            service.configure_coalesce(enabled=False)
+        return {
+            "chunk": chunk, "rounds": rounds,
+            "off": off, "on": on,
+            "speedup": round(on["ops_per_s"] / off["ops_per_s"], 2)
+            if off.get("ops_per_s") and on.get("ops_per_s") else None,
+            "goal_met": bool(
+                on.get("detected") == on.get("violating")
+                and off.get("detected") == off.get("violating")
+                and (on.get("batches") or 0) > 0
+                and (on.get("owners_max") or 0) >= 2),
+        }
+    except Exception as exc:  # noqa: BLE001 - numbers, not crashes
+        return {"error": repr(exc)[:300]}
+
+
 def _fleet_reuse_rung(time_limit_s=3, budget_s=600):
     """Cross-PROCESS compile reuse (jepsen_tpu.fleet.ledger): run the
     SAME 2x2 register matrix twice in two separate scheduler
@@ -1658,6 +1847,12 @@ def _bench_body(_obs_reg):
     # every chunk would otherwise pay, duty cycle from the
     # closure-busy counter
     rungs["15-txn-scale"] = _txn_scale_rung()
+
+    # stream-monitor rung: 100 concurrent monitored streams, flat
+    # re-search vs device-resident frontiers riding coalesced batches
+    # — monitored-ops/s, detection p50/p99, duty cycle, and the
+    # owners >= 2 batch-sharing evidence
+    rungs["16-stream-monitor"] = _stream_monitor_rung()
 
     # CPU oracles race in parallel subprocesses AFTER all device
     # measurements (their CPU load would pollute the device numbers);

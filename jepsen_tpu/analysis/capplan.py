@@ -235,7 +235,30 @@ def shapes_for_cell(params):
         except (KeyError, TypeError, ValueError) as e:
             raise UnknownShape(
                 f"workload {w!r}: {e!r}") from None
+    out.extend(_stream_monitor_shapes(params))
     return out
+
+
+def _stream_monitor_shapes(params):
+    """A cell monitored with ``engine: "streamlin"`` additionally
+    keeps one device-resident frontier per live stream
+    (``sizemodel.stream_frontier_shape``): quote it so the capacity
+    fit sees the resident tensors a hundred monitored streams pin
+    alongside the offline search's transient ones."""
+    mon = params.get("monitor")
+    if not isinstance(mon, dict) or mon.get("engine") != "streamlin":
+        return []
+    opts = mon.get("engine-opts") or {}
+    try:
+        from ..checker import streamlin
+        cap = int(opts.get("frontier-cap")
+                  or streamlin.DEFAULT_FRONTIER_CAP)
+        window = int(opts.get("window-cap")
+                     or streamlin.DEFAULT_WINDOW_CAP)
+        return [sizemodel.stream_frontier_shape(cap, window)]
+    except (KeyError, TypeError, ValueError):
+        # garbage knobs are PL026's complaint, not a planner crash
+        return []
 
 
 # ---------------------------------------------------------------------------

@@ -146,6 +146,18 @@ Codes:
                  differently and the verdicts are not comparable) --
                  errors; a txn monitor with a negative / non-numeric
                  skew-bound -- warning
+  PL026 mixed    stream engine (``engine: "streamlin"``, the
+                 device-resident frontier): a non-positive /
+                 non-integer frontier-cap, a cap above
+                 ``streamlin.FRONTIER_CAP_MAX``, or the stream engine
+                 on a checker tree with no Linearizable gate (there
+                 is no frontier to keep resident; the monitor would
+                 disable itself) -- errors; quiescent-carry
+                 explicitly off (every contained flat fall-back and
+                 violation confirm re-searches the UNBOUNDED prefix,
+                 exactly the O(prefix) cost the engine exists to
+                 delete), or a window-cap that is not a positive
+                 power of two -- warnings
 
 ``preflight(test)`` is the core.run hook: FATAL codes raise
 ``PlanLintError`` (opt out per test with ``test["preflight?"] =
@@ -688,6 +700,8 @@ def monitor_diags(test):
             f"{list(mengine.ENGINES)}: the monitor will fall back to "
             "its default",
             "plan.monitor.engine"))
+    if engine == "streamlin":
+        diags += _stream_engine_diags(test, cfg)
     checker = test.get("checker")
     if checker is not None:
         try:
@@ -719,6 +733,84 @@ def monitor_diags(test):
             "plan.monitor",
             "prefer fixing wedged clients over monitoring around "
             "them, or raise the op timeout"))
+    return diags
+
+
+def _stream_engine_diags(test, cfg):
+    """The PL026 rules over an ``engine: "streamlin"`` monitor config
+    (the device-resident configuration frontier, monitor/wgl_stream.py).
+
+    The stream engine's knobs bound DEVICE tensors, so garbage values
+    don't just waste work -- an absurd frontier-cap either can't
+    allocate or silently pins the engine in its flat fall-back, and a
+    carry-less stream pays the exact O(prefix) re-search the engine
+    exists to delete on every contained fall-back."""
+    diags = []
+    from .. import monitor as jmonitor
+    from ..checker import streamlin
+
+    opts = cfg.get("engine-opts") or {}
+    cap = opts.get("frontier-cap")
+    if cap is not None:
+        if not isinstance(cap, int) or isinstance(cap, bool) \
+                or cap <= 0:
+            diags.append(diag(
+                "PL026", ERROR,
+                f"streamlin frontier-cap must be a positive integer, "
+                f"got {cap!r}",
+                "plan.monitor.engine-opts.frontier-cap",
+                "the cap bounds the device-resident config-set tensor "
+                f"(default {streamlin.DEFAULT_FRONTIER_CAP}); the "
+                "engine pow-2-grows toward it and falls back to the "
+                "flat re-search past it"))
+        elif cap > streamlin.FRONTIER_CAP_MAX:
+            diags.append(diag(
+                "PL026", ERROR,
+                f"streamlin frontier-cap {cap} exceeds the engine "
+                f"maximum {streamlin.FRONTIER_CAP_MAX}: the frontier "
+                "tensor is (cap, window/32) uint32 PER STREAM and "
+                "keyed tests hold one stream per key",
+                "plan.monitor.engine-opts.frontier-cap",
+                "histories needing frontiers this wide belong on the "
+                "offline engine's budgets, not in a monitor chunk"))
+    wcap = opts.get("window-cap")
+    if wcap is not None and (not isinstance(wcap, int)
+                             or isinstance(wcap, bool) or wcap <= 0
+                             or wcap & (wcap - 1)):
+        diags.append(diag(
+            "PL026", WARNING,
+            f"streamlin window-cap should be a positive power of two, "
+            f"got {wcap!r}: the engine rounds it up (window words are "
+            "32 slots and growth doubles)",
+            "plan.monitor.engine-opts.window-cap"))
+    checker = test.get("checker")
+    if checker is not None:
+        try:
+            lin, _keyed = jmonitor.find_linearizable(checker)
+        except Exception:  # noqa: BLE001 - reflection is best-effort
+            lin = True
+        if lin is None:
+            diags.append(diag(
+                "PL026", ERROR,
+                "engine streamlin on a checker tree with no "
+                "linearizable gate: there is no configuration "
+                "frontier to keep device-resident and the monitor "
+                "will disable itself at runtime",
+                "plan.monitor.engine",
+                "monitor a linearizable workload, or for "
+                'transactional families use monitor family "txn" '
+                "(its own incremental frontier)"))
+    if cfg.get("quiescent-carry?") is False:
+        diags.append(diag(
+            "PL026", WARNING,
+            "engine streamlin with quiescent-carry explicitly off: "
+            "the device frontier stays O(window), but every contained "
+            "fall-back and violation confirm re-searches the "
+            "UNBOUNDED materialized prefix -- the exact O(prefix) "
+            "cost the stream engine exists to delete",
+            "plan.monitor.quiescent-carry?",
+            "leave the carry on (the default) so flat fall-backs stay "
+            "bounded by the open window"))
     return diags
 
 

@@ -30,7 +30,7 @@ from __future__ import annotations
 __all__ = ["INT32_CELL_LIMIT", "BYTES_PER_CELL", "bucket", "n_floor",
            "bucket_for", "plan_sizes", "history_cells", "history_ranks",
            "buffer_cells", "int32_wall", "hbm_bytes", "search_shape",
-           "closure_shape", "ledger_key_shape"]
+           "closure_shape", "stream_frontier_shape", "ledger_key_shape"]
 
 #: cells (int32 lanes) addressable before device indices overflow --
 #: the wall the packed-encoding roadmap item exists to break
@@ -231,6 +231,51 @@ def closure_shape(n_txns, *, lo=64):
     }
 
 
+def stream_frontier_shape(frontier_cap, window, *, state_size=1,
+                          arg_width=2, open_cap=None, events=64):
+    """The symbolic prediction for one monitored stream's
+    device-resident frontier (``checker/streamlin`` /
+    ``monitor/wgl_stream.StreamCheck``): the frontier rows pad to a
+    pow-2 bucket and the device keeps, per stream, the uint32
+    linearized bitsets (F x window/32 words), the int32 model states
+    (F x S), the open-op bitset, and the window's encoded cells. The
+    closure's transient pool is (F + F*C) candidate rows, C the open-op
+    axis. Per-chunk fold cost is O(events x passes x F x C) --
+    independent of the stream's consumed prefix, which is the number
+    this module exists to let capplan quote."""
+    F = bucket(max(1, int(frontier_cap)), 1)
+    NW = bucket(max(1, int(window)), 32)
+    B = max(1, NW // 32)
+    S = max(1, int(state_size))
+    A = max(1, int(arg_width))
+    C = bucket(max(1, int(open_cap if open_cap is not None else 8)), 1)
+    E = bucket(max(1, int(events)), 1)
+    per = BYTES_PER_CELL
+    pool = F + F * C
+    hbm = {
+        "lin": F * B * per,                      # uint32 bitset words
+        "state": F * S * per,                    # int32 model states
+        "window": NW * (1 + 2 * A) * per,        # f + args + ret cells
+        "open": B * per,
+        "pool": pool * (B + S) * per,            # closure transient
+    }
+    hbm["total"] = sum(hbm.values())
+    cells = pool * (B + S)
+    return {
+        "model": "streamlin",
+        "engine": "streamlin",
+        "frontier_cap": F,
+        "bucket": F,
+        "window": NW,
+        "open_cap": C,
+        "events": E,
+        "fold_cells": E * F * C,                 # per-chunk, O(window)
+        "hbm": hbm,
+        "int32": {"cells": cells, "which": "closure candidate pool",
+                  "frac": round(cells / INT32_CELL_LIMIT, 6)},
+    }
+
+
 # ---------------------------------------------------------------------------
 # ledger-key projection: what the engines actually noted
 
@@ -240,7 +285,12 @@ def closure_shape(n_txns, *, lo=64):
 #: check_batch_encoded: (spec.name, K, W, n_pad, B, S_pad, C, A, ...)).
 #: tests/test_capplan.py pins this against a live run, so a key-layout
 #: change there fails here instead of silently skewing the oracle.
-_LEDGER_KEY_BUCKET_INDEX = {"jax-wgl": 1, "jax-wgl-batch": 3}
+_LEDGER_KEY_BUCKET_INDEX = {"jax-wgl": 1, "jax-wgl-batch": 3,
+                            # streamlin solo (name, 1, F, B, S, C, E, A)
+                            # / batch (name, K, F, B, S, C, E, A): the
+                            # frontier capacity F is the shape axis the
+                            # planner models (events ride axis 6)
+                            "streamlin": 2, "streamlin-batch": 2}
 
 
 def ledger_key_shape(engine, key):
@@ -248,10 +298,18 @@ def ledger_key_shape(engine, key):
     shape capplan predicts -- or None for engines the planner does not
     model. ``key`` is the canonicalized key tuple/list the ledger
     stores (model name first)."""
-    idx = _LEDGER_KEY_BUCKET_INDEX.get(str(engine))
+    engine = str(engine)
+    idx = _LEDGER_KEY_BUCKET_INDEX.get(engine)
     if idx is None:
         return None
     try:
+        if engine.startswith("streamlin"):
+            # stream-fold keys lead with the MODEL spec name but the
+            # planner quotes one "streamlin" pseudo-model per cell
+            # (frontier shapes don't vary by register flavor the way
+            # search plans do) -- project onto it so the oracle
+            # compares like with like
+            return ("streamlin", int(key[idx]))
         return (str(key[0]), int(key[idx]))
     except (IndexError, TypeError, ValueError):
         return None

@@ -1,0 +1,560 @@
+"""Device-resident incremental linearizability: the streaming frontier
+fold.
+
+``checker/linear.py`` sweeps a history's *events* in time order,
+maintaining the set of configurations (linearized-bitset, model state)
+consistent with the prefix so far; an empty set at a return event is
+the violation. That formulation is already the papers' online
+monitoring algorithm ("Efficient Decrease-and-Conquer Linearizability
+Monitoring", arXiv 2410.04581; "Efficient Linearizability Monitoring",
+arXiv 2509.17795): the config set after a prefix is a *frontier* that
+later events only ever extend. This module ports the sweep into one
+batched jitted step:
+
+    fold :: (frontier tensor, newly encoded cells) -> extended frontier
+
+so the monitor can keep the frontier ON DEVICE across chunk boundaries
+(``monitor/wgl_stream.py`` owns the seal/probe split that makes the
+carry sound) and re-check a live stream in O(window) instead of
+re-searching the O(prefix) encoding every chunk.
+
+Device layout (all pow-2, ledger-hitting shapes):
+
+* ``lin``   (F, B) uint32  -- per-config linearized bitset over window
+                              SLOTS (B = NW/32 words; a slot, not a
+                              history index, so the window can recycle)
+* ``st``    (F, S) int32   -- per-config model state (fixed-width
+                              models only; dynamic sizes fall back)
+* ``live``  (F,)   bool    -- which frontier rows are real configs
+* ``open_w``(B,)   uint32  -- the open-op slot set as a bitset
+* events    (E,)   kind/slot -- 1 = invoke (opens the slot),
+                              2 = return (forces the closure)
+
+At a return event the kernel runs the same BFS closure as the CPU
+sweep: every not-yet-done config expands by every open op through the
+branch-free ``spec.step`` (vmapped over F*C candidates), the pool
+dedups by a 64-bit multiply-shift fingerprint pair (sort + adjacent
+compare, the jax_wgl dedup idiom -- a collision can only DROP a
+config, shrinking the frontier, so it can cause a spurious violation
+which the caller confirms offline, never a missed one), and the
+surviving set compacts back into the F rows. ``n_keep > F`` or more
+than C simultaneously-open slots flags overflow (status 2): the caller
+pow-2-grows the capacity through ``compile_cache.bucket_for`` and
+retries, or falls back to the flat engines -- statuses never silently
+truncate, so the engine can never flip a verdict.
+
+``check_encoded`` is the offline face: one fold over a whole encoded
+history, returning the same verdict names as ``linear.check_encoded``
+(True / False / "unknown" with ``max-configs-exceeded``). The
+coalescer-facing half (``fold_lane_spec`` / ``FoldJob`` /
+``batch_fold``) lets hundreds of monitored streams ride one vmapped
+dispatch per ``(model, event bucket)`` group, exactly like ``/api/check``
+tenants share ``keyshard.check_batch_encoded`` batches.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+import time
+
+import numpy as np
+
+from ..history import INF_TIME
+from ..obs import search as obs_search
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DEFAULT_FRONTIER_CAP", "FRONTIER_CAP_MAX",
+           "DEFAULT_WINDOW_CAP", "WINDOW_FLOOR", "OPEN_FLOOR",
+           "EVENT_FLOOR", "STREAM_LANE_PREFIX", "FoldJob",
+           "fold_lane_spec", "fresh_frontier", "solo_fold",
+           "batch_fold", "check_encoded"]
+
+#: default / hard maximum frontier capacity (configs). The default is
+#: generous for low-contention streams (the config set usually stays
+#: tiny); planlint PL026 rejects caps outside (0, FRONTIER_CAP_MAX].
+DEFAULT_FRONTIER_CAP = 4096
+FRONTIER_CAP_MAX = 65536
+
+#: initial frontier capacity floor. Growth rides the campaign-wide
+#: ``compile_cache.bucket_for`` ladder (a RAISED op-count floor
+#: coarsens frontier shapes too, fewer compiles), but the op-count
+#: knob must never shrink the starting frontier to 1 config -- a
+#: floor tuned low for tiny histories says nothing about how many
+#: consistent configurations a sweep holds live.
+FRONTIER_FLOOR = 64
+
+#: window slot capacity: unsealed + forever-open (info) rows live in
+#: slots; past the cap the stream degrades to flat re-checks (counted,
+#: contained -- crash-heavy histories are the CPU sweep's weakness too)
+DEFAULT_WINDOW_CAP = 4096
+WINDOW_FLOOR = 64
+
+#: pow-2 floors for the open-op candidate axis and the event axis
+OPEN_FLOOR = 8
+EVENT_FLOOR = 64
+
+#: the coalescer lane's model-name prefix: monitor folds queue per
+#: ("streamlin:<model>", pow-2 event bucket) like WGL tenants queue
+#: per (model, op bucket)
+STREAM_LANE_PREFIX = "streamlin:"
+
+#: positional order of FoldJob.arrays as the kernel wants them
+_ARRAY_ORDER = ("lin", "st", "live", "open_w", "ev_kind", "ev_slot",
+                "w_f", "w_args", "w_ret", "clear_w")
+
+
+def _bucket(x, lo=1):
+    from ..campaign import compile_cache
+    return compile_cache.bucket(x, lo)
+
+
+def _note(engine, key):
+    """Compile-reuse ledger note, contained (the ledger is telemetry,
+    never verdict-bearing)."""
+    try:
+        from ..campaign import compile_cache
+        return compile_cache.note(engine, key)
+    except Exception:  # noqa: BLE001 - telemetry-grade only
+        return None
+
+
+@functools.lru_cache(maxsize=128)
+def _build_fold(step, K, F, B, S, C, E, A):
+    """Compile the fold for one shape. ``step`` is the model's
+    branch-free transition (hashable: ModelSpec.step functions are
+    module-level); K streams ride one vmapped dispatch (K=1 skips the
+    vmap so lax.cond stays a real branch, not a select)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    NW = B * 32
+    vstep = jax.vmap(lambda stv, fv, av, rv: step(stv, fv, av, rv, jnp))
+
+    def fingerprints(lin_w, st_w):
+        # two independent 32-bit multiply-shift sums over the config's
+        # words, position salted by per-column constants (the jax_wgl
+        # incremental-fingerprint idiom)
+        w = jnp.concatenate(
+            [lin_w, lax.bitcast_convert_type(st_w, jnp.uint32)], axis=1)
+        idx = jnp.arange(B + S, dtype=jnp.uint32)
+
+        def mix(c_mul, c_add):
+            c = idx * jnp.uint32(c_mul) + jnp.uint32(c_add)
+            t = w * c[None, :]
+            t = t ^ (t >> 15)
+            t = t * jnp.uint32(0x2C1B3C6D)
+            t = t ^ (t >> 12)
+            return t.sum(axis=1, dtype=jnp.uint32)
+
+        return (mix(0x9E3779B1, 0x85EBCA6B),
+                mix(0xC2B2AE35, 0x27D4EB2F))
+
+    def fold_one(lin, st, live, open_w, ev_kind, ev_slot,
+                 w_f, w_args, w_ret, clear_w):
+        slot_ids = jnp.arange(NW, dtype=jnp.int32)
+
+        def ev_body(carry, ev):
+            lin, st, live, open_w, status, viol, passes, steps = carry
+            kind, slot = ev
+            s = jnp.clip(slot, 0, NW - 1)
+            word = s // 32
+            bit = jnp.uint32(1) << jnp.uint32(s % 32)
+            act = status == 0
+            # invoke: the op merely becomes available
+            inv_w = open_w.at[word].set(open_w[word] | bit)
+            open_w = jnp.where(act & (kind == 1), inv_w, open_w)
+
+            def tbit(lin_w):
+                return ((lin_w[:, word] >> jnp.uint32(s % 32))
+                        & jnp.uint32(1)) == 1
+
+            def closure(op):
+                # return of slot s: every config must linearize
+                # sequences of open ops until s is linearized; configs
+                # that can't are discarded (linear.py expand_until)
+                lin, st, live, open_w, status, viol, passes, steps = op
+                bits = ((open_w[:, None]
+                         >> jnp.arange(32, dtype=jnp.uint32)[None, :])
+                        & jnp.uint32(1)).astype(jnp.int32).reshape(NW)
+                n_open = jnp.sum(bits)
+                # open slot ids, padded with NW (sort-based: vmappable)
+                oidx = jnp.sort(jnp.where(bits > 0, slot_ids, NW))[:C]
+                j_valid = oidx < NW
+                jc = jnp.minimum(oidx, NW - 1)
+                f_j = w_f[jc]
+                a_j = w_args[jc]
+                r_j = w_ret[jc]
+                j_word = jc // 32
+                j_sh = jnp.uint32(jc % 32)
+                j_bit = jnp.uint32(1) << j_sh
+                add_mask = jnp.where(
+                    jnp.arange(B)[None, :] == j_word[:, None],
+                    j_bit[:, None], jnp.uint32(0))        # (C, B)
+
+                def w_cond(stt):
+                    _l, _s, _seen, work, p, _stp, ovf = stt
+                    return jnp.any(work) & ~ovf & (p < C + 1)
+
+                def w_body(stt):
+                    lin, st, seen, work, p, stp, ovf = stt
+                    pst = jnp.broadcast_to(
+                        st[:, None, :], (F, C, S)).reshape(F * C, S)
+                    pf = jnp.broadcast_to(
+                        f_j[None, :], (F, C)).reshape(F * C)
+                    pa = jnp.broadcast_to(
+                        a_j[None, :, :], (F, C, A)).reshape(F * C, A)
+                    pr = jnp.broadcast_to(
+                        r_j[None, :, :], (F, C, A)).reshape(F * C, A)
+                    st2, ok = vstep(pst, pf, pa, pr)
+                    st2 = jnp.asarray(st2, jnp.int32).reshape(F * C, S)
+                    ok = jnp.asarray(ok, bool).reshape(F * C)
+                    already = ((lin[:, j_word] >> j_sh[None, :])
+                               & jnp.uint32(1)) == 1       # (F, C)
+                    parent_ok = (work[:, None] & j_valid[None, :]
+                                 & ~already)
+                    stp = stp + jnp.sum(parent_ok.astype(jnp.int32))
+                    cand_valid = parent_ok.reshape(F * C) & ok
+                    cand_lin = (lin[:, None, :]
+                                | add_mask[None, :, :]).reshape(F * C, B)
+                    # dedup pool: the F survivors-so-far + all F*C
+                    # candidates; old entries sort first among equal
+                    # fingerprints so the established config wins
+                    pool_lin = jnp.concatenate([lin, cand_lin], 0)
+                    pool_st = jnp.concatenate([st, st2], 0)
+                    pool_v = jnp.concatenate([seen, cand_valid], 0)
+                    pool_o = jnp.concatenate(
+                        [jnp.ones(F, bool), jnp.zeros(F * C, bool)], 0)
+                    h1, h2 = fingerprints(pool_lin, pool_st)
+                    order = jnp.lexsort((
+                        (~pool_o).astype(jnp.uint32), h2, h1,
+                        (~pool_v).astype(jnp.uint32)))
+                    sl = pool_lin[order]
+                    ss = pool_st[order]
+                    sv = pool_v[order]
+                    so = pool_o[order]
+                    sh1 = h1[order]
+                    sh2 = h2[order]
+                    dup = jnp.concatenate([
+                        jnp.zeros(1, bool),
+                        (sh1[1:] == sh1[:-1]) & (sh2[1:] == sh2[:-1])
+                        & sv[1:] & sv[:-1]])
+                    keep = sv & ~dup
+                    n_keep = jnp.sum(keep.astype(jnp.int32))
+                    ovf = ovf | (n_keep > F)
+                    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+                    pos = jnp.where(keep, jnp.minimum(pos, F), F)
+                    nlin = jnp.zeros((F + 1, B),
+                                     jnp.uint32).at[pos].set(sl)[:F]
+                    nst = jnp.zeros((F + 1, S),
+                                    jnp.int32).at[pos].set(ss)[:F]
+                    nseen = jnp.zeros(F + 1,
+                                      bool).at[pos].set(keep)[:F]
+                    nold = jnp.zeros(F + 1,
+                                     bool).at[pos].set(so & keep)[:F]
+                    # fresh configs without the target go back to work;
+                    # expanded (old) ones retire to dedup-only ghosts
+                    nwork = nseen & ~tbit(nlin) & ~nold
+                    return nlin, nst, nseen, nwork, p + 1, stp, ovf
+
+                work0 = live & ~tbit(lin)
+                lin2, st2, seen2, _w, local_p, steps2, ovf = \
+                    lax.while_loop(
+                        w_cond, w_body,
+                        (lin, st, live, work0, jnp.int32(0), steps,
+                         n_open > C))
+                final = seen2 & tbit(lin2)
+                violated = ~jnp.any(final) & ~ovf
+                open_w2 = open_w.at[word].set(open_w[word] & ~bit)
+                status2 = jnp.where(
+                    ovf, jnp.int32(2),
+                    jnp.where(violated, jnp.int32(1), status))
+                viol2 = jnp.where(violated, s, viol)
+                return (lin2, st2, final, open_w2, status2, viol2,
+                        passes + local_p, steps2)
+
+            carry2 = lax.cond(
+                act & (kind == 2), closure, lambda op: op,
+                (lin, st, live, open_w, status, viol, passes, steps))
+            return carry2, None
+
+        carry, _ = lax.scan(
+            ev_body,
+            (lin, jnp.asarray(st, jnp.int32), live, open_w,
+             jnp.int32(0), jnp.int32(-1), jnp.int32(0), jnp.int32(0)),
+            (ev_kind, ev_slot))
+        lin, st, live, open_w, status, viol, passes, steps = carry
+        # recycle fully-sealed slots: their bit is set in EVERY live
+        # config (the return event forced it), so clearing is uniform
+        lin = lin & ~clear_w[None, :]
+        return (lin, st, live, open_w, status, viol, passes, steps,
+                jnp.sum(live.astype(jnp.int32)))
+
+    fn = fold_one if K == 1 else jax.vmap(fold_one)
+    return jax.jit(fn)
+
+
+def fresh_frontier(F, B, S, init_state):
+    """The singleton frontier {(nothing linearized, init_state)} as
+    host arrays shaped for the fold."""
+    lin = np.zeros((F, B), np.uint32)
+    st = np.zeros((F, S), np.int32)
+    st[0] = np.asarray(init_state, np.int32)
+    live = np.zeros(F, bool)
+    live[0] = True
+    open_w = np.zeros(B, np.uint32)
+    return lin, st, live, open_w
+
+
+class FoldJob:
+    """One stream's frontier-extension step, packaged for the solo
+    path or a coalesced batch. ``arrays`` follow ``_ARRAY_ORDER``;
+    ``len(job)`` is the REAL event count (the coalescer's bucketing
+    measure). Event arrays must be host numpy (batch padding); the
+    frontier/window tensors may be device-resident jax arrays."""
+
+    __slots__ = ("spec", "C", "arrays", "n_events")
+
+    def __init__(self, spec, C, arrays, n_events):
+        self.spec = spec
+        self.C = int(C)
+        self.arrays = arrays
+        self.n_events = int(n_events)
+
+    def __len__(self):
+        return self.n_events
+
+    @property
+    def F(self):
+        return int(self.arrays["lin"].shape[0])
+
+    @property
+    def B(self):
+        return int(self.arrays["lin"].shape[1])
+
+    @property
+    def S(self):
+        return int(self.arrays["st"].shape[1])
+
+    @property
+    def E(self):
+        return int(self.arrays["ev_kind"].shape[0])
+
+    @property
+    def A(self):
+        return int(self.arrays["w_args"].shape[1])
+
+    def shape_key(self):
+        return (self.spec.name, self.F, self.B, self.S, self.C, self.A)
+
+
+class _FoldLaneSpec:
+    """The coalescer's stand-in "model" for stream frontier folds:
+    monitored streams queue per (``streamlin:<model>``, pow-2 event
+    bucket) exactly like WGL tenants queue per (model, op bucket), and
+    one vmapped fold answers the whole batch (``batch_fold``)."""
+
+    __slots__ = ("name", "spec")
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.name = STREAM_LANE_PREFIX + spec.name
+
+
+_lane_lock = threading.Lock()
+_lane_specs: dict = {}
+
+
+def fold_lane_spec(spec):
+    """The interned coalescer lane spec for a model (one per model so
+    every stream of that model shares the lane)."""
+    with _lane_lock:
+        lane = _lane_specs.get(spec.name)
+        if lane is None:
+            lane = _lane_specs[spec.name] = _FoldLaneSpec(spec)
+        return lane
+
+
+def _scalars(job_or_key, out, idx=None):
+    import jax
+    status, viol, passes, steps, n_live = jax.device_get(
+        (out[4], out[5], out[6], out[7], out[8]))
+    if idx is not None:
+        status, viol, passes, steps, n_live = (
+            status[idx], viol[idx], passes[idx], steps[idx], n_live[idx])
+    return (int(status), int(viol), int(passes), int(steps),
+            int(n_live))
+
+
+def solo_fold(job):
+    """Run one FoldJob locally (the containment path when no
+    coalescer is live, a batch failed, or a deadline passed). Returns
+    the fold result dict; the frontier tensors stay device-resident
+    jax arrays for the caller to re-commit."""
+    fn = _build_fold(job.spec.step, 1, job.F, job.B, job.S, job.C,
+                     job.E, job.A)
+    _note("streamlin", (job.spec.name, 1, job.F, job.B, job.S, job.C,
+                        job.E, job.A))
+    t0 = time.monotonic()
+    out = fn(*(job.arrays[k] for k in _ARRAY_ORDER))
+    status, viol, passes, steps, n_live = _scalars(job, out)
+    return {"engine": "streamlin", "status": status,
+            "viol_slot": viol, "passes": passes, "steps": steps,
+            "n_live": n_live, "lin": out[0], "st": out[1],
+            "live": out[2], "open_w": out[3],
+            "device_s": time.monotonic() - t0}
+
+
+def _pad_events(a, E):
+    a = np.asarray(a, np.int32)
+    if a.shape[0] == E:
+        return a
+    return np.pad(a, (0, E - a.shape[0]))
+
+
+def batch_fold(jobs, owners=None, e_bucket=None):
+    """Run many FoldJobs as vmapped device batches, grouped by full
+    shape key (the lane name only pins the model; a defensive regroup
+    here means a mixed batch can never mis-stack). Frontier-extension
+    steps from strangers' streams ride one compiled dispatch; K pads
+    to a pow-2 with inert (zero-event) members. Returns one result
+    dict per job, in order."""
+    import jax
+    import jax.numpy as jnp
+
+    results = [None] * len(jobs)
+    groups: dict = {}
+    for i, job in enumerate(jobs):
+        groups.setdefault(job.shape_key(), []).append(i)
+    t0 = time.monotonic()
+    for key, idxs in groups.items():
+        members = [jobs[i] for i in idxs]
+        if len(members) == 1:
+            results[idxs[0]] = dict(solo_fold(members[0]), batch=1)
+            continue
+        spec = members[0].spec
+        _name, F, B, S, C, A = key
+        E = _bucket(max(max(m.E for m in members), int(e_bucket or 1)),
+                    EVENT_FLOOR)
+        K = _bucket(len(members), 1)
+        fn = _build_fold(spec.step, K, F, B, S, C, E, A)
+        _note("streamlin-batch", (spec.name, K, F, B, S, C, E, A))
+        stacks = []
+        for name in _ARRAY_ORDER:
+            parts = []
+            for m in members:
+                a = m.arrays[name]
+                if name in ("ev_kind", "ev_slot"):
+                    a = _pad_events(a, E)
+                parts.append(jnp.asarray(a))
+            # pad members are member 0 with no events: a fold over
+            # zero events is the identity, so the lane is inert
+            for _ in range(K - len(members)):
+                parts.append(jnp.zeros(E, jnp.int32)
+                             if name in ("ev_kind", "ev_slot")
+                             else parts[0])
+            stacks.append(jnp.stack(parts))
+        out = fn(*stacks)
+        for pos, i in enumerate(idxs):
+            status, viol, passes, steps, n_live = _scalars(
+                members[pos], out, pos)
+            results[i] = {"engine": "streamlin", "status": status,
+                          "viol_slot": viol, "passes": passes,
+                          "steps": steps, "n_live": n_live,
+                          "lin": out[0][pos], "st": out[1][pos],
+                          "live": out[2][pos], "open_w": out[3][pos],
+                          "batch": len(members)}
+    dt = time.monotonic() - t0
+    try:
+        so = obs_search.capture()
+        n_owners = len(set(owners)) if owners else 1
+        so.plan("streamlin-batch",
+                _bucket(max((len(j) for j in jobs), default=1),
+                        EVENT_FLOOR),
+                sum(len(j) for j in jobs),
+                sum(j.E for j in jobs), keys=len(jobs),
+                owners=n_owners)
+        so.heartbeat("streamlin-batch", iteration=1, chunk_s=dt,
+                     device_s=dt, frontier=max(
+                         (r["n_live"] for r in results if r), default=0))
+    except Exception:  # noqa: BLE001 - telemetry-grade only
+        logger.warning("streamlin batch telemetry failed", exc_info=True)
+    return results
+
+
+def check_encoded(spec, e, init_state, max_configs=DEFAULT_FRONTIER_CAP,
+                  cancel=None):
+    """The offline face: one frontier fold over a whole encoded
+    history. Same verdict names as ``linear.check_encoded`` (True /
+    False with the violating ``op`` / "unknown" with
+    ``max-configs-exceeded``); ``configs_explored`` counts model-step
+    evaluations. On False the streaming monitor re-confirms through a
+    flat engine for the witness artifact set -- this face reports the
+    violating op only."""
+    n = len(e)
+    if n == 0 or e.n_ok == 0:
+        return {"valid": True, "configs_explored": 0,
+                "engine": "streamlin"}
+    init = np.asarray(init_state, np.int32)
+    S = max(1, int(init.shape[0]))
+    A = int(spec.arg_width)
+    NW = _bucket(n, WINDOW_FLOOR)
+    B = NW // 32
+    events = sorted(
+        [(int(e.invoke_idx[i]), 1, i) for i in range(n)]
+        + [(int(e.return_idx[i]), 2, i) for i in range(n)
+           if e.return_idx[i] < INF_TIME])
+    c_now = c_max = 0
+    for _t, kind, _i in events:
+        c_now += 1 if kind == 1 else -1
+        c_max = max(c_max, c_now)
+    C = min(NW, _bucket(max(1, c_max), OPEN_FLOOR))
+    E = _bucket(len(events), EVENT_FLOOR)
+    ev_kind = np.zeros(E, np.int32)
+    ev_slot = np.zeros(E, np.int32)
+    for k, (_t, kind, i) in enumerate(events):
+        ev_kind[k] = kind
+        ev_slot[k] = i
+    w_f = np.zeros(NW, np.int32)
+    w_args = np.zeros((NW, A), np.int32)
+    w_ret = np.zeros((NW, A), np.int32)
+    w_f[:n] = e.f
+    w_args[:n] = np.asarray(e.args, np.int32).reshape(n, A)
+    w_ret[:n] = np.asarray(e.ret, np.int32).reshape(n, A)
+    cap = min(_bucket(max(1, int(max_configs))), FRONTIER_CAP_MAX)
+    from ..campaign import compile_cache
+    F = min(cap, max(FRONTIER_FLOOR, compile_cache.bucket_for(1)))
+    steps = 0
+    while True:
+        if cancel is not None and cancel.is_set():
+            return {"valid": "unknown", "error": "cancelled",
+                    "configs_explored": steps, "engine": "streamlin"}
+        lin, st, live, open_w = fresh_frontier(F, B, S, init)
+        job = FoldJob(spec, C, {
+            "lin": lin, "st": st, "live": live, "open_w": open_w,
+            "ev_kind": ev_kind, "ev_slot": ev_slot, "w_f": w_f,
+            "w_args": w_args, "w_ret": w_ret,
+            "clear_w": np.zeros(B, np.uint32)}, len(events))
+        r = solo_fold(job)
+        steps += r["steps"]
+        if r["status"] == 2 and F < cap:
+            F = min(cap, F * 2)
+            continue
+        break
+    if r["status"] == 2:
+        return {"valid": "unknown", "error": "max-configs-exceeded",
+                "configs_explored": steps, "engine": "streamlin",
+                "frontier_cap": F}
+    if r["status"] == 1:
+        out = {"valid": False, "configs_explored": steps,
+               "engine": "streamlin"}
+        i = r["viol_slot"]
+        if e.ops is not None and 0 <= i < len(e.ops):
+            inv, comp = e.ops[i]
+            out["op"] = dict(comp if comp is not None else inv)
+        return out
+    return {"valid": True, "configs_explored": steps,
+            "engine": "streamlin", "frontier": r["n_live"]}

@@ -380,6 +380,13 @@ class _TxnClosureSpec:
 
 TXN_CLOSURE_SPEC = _TxnClosureSpec()
 
+#: monitored-stream frontier lanes: one lane per model, named
+#: "streamlin:<model>" (checker/streamlin.STREAM_LANE_PREFIX --
+#: duplicated as a constant so lane ROUTING never imports jax). Stream
+#: tenants queue per (lane, pow-2 event bucket) exactly like WGL
+#: tenants, and one vmapped fold extends the whole batch's frontiers.
+STREAM_LANE_PREFIX = "streamlin:"
+
 
 class _PendingSegment:
     """One encoded segment waiting in (or delivered by) the batcher.
@@ -650,6 +657,17 @@ class Coalescer:
                     [it.pair[0] for it in live],
                     n_floor=bucket or 64)
                 results = [{"cyclic": bool(f)} for f in flags]
+            elif spec.name.startswith(STREAM_LANE_PREFIX):
+                # monitored-stream tenants: frontier-extension folds
+                # from strangers' streams stack into one compiled
+                # dispatch (checker/streamlin.batch_fold regroups by
+                # full tensor shape, so a mid-flight frontier grow
+                # never mis-stacks a batch)
+                from ..checker import streamlin
+                results = streamlin.batch_fold(
+                    [it.pair[0] for it in live],
+                    owners=[it.owner for it in live],
+                    e_bucket=bucket)
             else:
                 from ..parallel import keyshard
                 # pad the batch to its GROUP bucket, not a re-derived
@@ -697,10 +715,14 @@ class Coalescer:
                 # the queue wait is also a named phase in the
                 # time-attribution plane (obs.phases): idle the bubble
                 # ledger books against "wait", not mystery residual
-                obs_phases.note_wait(
-                    spec.name if spec.name == TXN_CLOSURE_SPEC.name
-                    else "jax-wgl-batch",
-                    t_dispatch - it.enqueued, owner=it.owner)
+                if spec.name == TXN_CLOSURE_SPEC.name:
+                    lane = spec.name
+                elif spec.name.startswith(STREAM_LANE_PREFIX):
+                    lane = "streamlin-batch"
+                else:
+                    lane = "jax-wgl-batch"
+                obs_phases.note_wait(lane, t_dispatch - it.enqueued,
+                                     owner=it.owner)
         except Exception:  # noqa: BLE001
             logger.warning("coalesce accounting failed", exc_info=True)
 

@@ -241,8 +241,38 @@ class Monitor:
             out["unkeyed_ops_skipped"] = self.unkeyed_skipped
         if self.quiescent_carry:
             out["quiescent_truncated_ops"] = self.truncated_ops
+        stream = self._stream_summary()
+        if stream is not None:
+            out["stream"] = stream
         if self.violation is not None:
             out.update(self.violation)
+        return out
+
+    #: stream counters reported as the max across keys (sizes/capacities
+    #: describe a single stream's state, not fleet-wide volume)
+    _STREAM_MAX_KEYS = ("frontier_size", "frontier_peak", "frontier_cap",
+                        "window", "open_slots", "batch_peak")
+
+    def _stream_summary(self):
+        """Aggregate per-key StreamCheck telemetry (engine streamlin
+        only): counters sum across keys, sizes take the max, and the
+        first fall-back reason is surfaced so an accidentally-degraded
+        run is visible in results["monitor"]["stream"]."""
+        blocks = [enc.stream_summary() for enc in self._encoders.values()
+                  if callable(getattr(enc, "stream_summary", None))]
+        if not blocks:
+            return None
+        out = {}
+        for b in blocks:
+            for k, v in b.items():
+                if k == "fallback":
+                    out.setdefault(k, v)
+                elif k in self._STREAM_MAX_KEYS:
+                    out[k] = max(out.get(k, 0), v)
+                else:
+                    out[k] = out.get(k, 0) + v
+        if "device_s" in out:
+            out["device_s"] = round(out["device_s"], 4)
         return out
 
     # -- monitor thread ----------------------------------------------------
@@ -259,8 +289,21 @@ class Monitor:
     def _encoder(self, key):
         enc = self._encoders.get(key)
         if enc is None:
-            enc = self._encoders[key] = StreamEncoder(
-                self.spec, self.init_ops)
+            if self.engine == "streamlin":
+                # the device-resident frontier driver; duck-types the
+                # StreamEncoder surface and adds check(). Contained: a
+                # construction failure falls back to the plain encoder
+                # (whose checks then run streamlin's flat face)
+                try:
+                    from .wgl_stream import StreamCheck
+                    enc = StreamCheck(self.spec, self.init_ops,
+                                      opts=self.engine_opts)
+                except Exception:  # noqa: BLE001
+                    logger.warning("StreamCheck init failed; flat "
+                                   "re-checks", exc_info=True)
+            if enc is None:
+                enc = StreamEncoder(self.spec, self.init_ops)
+            self._encoders[key] = enc
         return enc
 
     def _consume(self, op, idx, t):
@@ -292,9 +335,16 @@ class Monitor:
         """Materialize + check one key's prefix; returns its validity
         and records a violation on False."""
         enc = self._encoders[key]
-        e, init_state = enc.materialize()
+        stream = callable(getattr(enc, "check", None))
+        e = init_state = None
+        if not stream:
+            # streamlin keeps the encoded prefix device-resident; the
+            # host only materializes it on the flat paths (carry cuts,
+            # violation evidence) below
+            e, init_state = enc.materialize()
         t0 = _time.monotonic()
-        sem = self.device_sem if self.engine == "jax-wgl" else None
+        sem = self.device_sem \
+            if self.engine in ("jax-wgl", "streamlin") else None
         if sem is not None:
             t_w = _time.monotonic()
             sem.acquire()
@@ -308,10 +358,14 @@ class Monitor:
             obs_phases.note_wait(self.engine,
                                  _time.monotonic() - t_w)
         try:
-            with self._span("monitor.check", key=repr(key), n=len(e)):
-                r = mengine.check_prefix(
-                    self.spec, e, init_state, self.engine,
-                    self.engine_opts, cancel=self._cancel)
+            with self._span("monitor.check", key=repr(key),
+                            n=len(enc)):
+                if stream:
+                    r = enc.check(cancel=self._cancel)
+                else:
+                    r = mengine.check_prefix(
+                        self.spec, e, init_state, self.engine,
+                        self.engine_opts, cancel=self._cancel)
         finally:
             if sem is not None:
                 sem.release()
@@ -338,6 +392,8 @@ class Monitor:
             # on that combination).
             try:
                 from ..analysis import searchplan
+                if e is None:
+                    e, init_state = enc.materialize()
                 cut = searchplan.stream_cut(self.spec, e)
                 if cut is not None:
                     dropped = enc.truncate_before(*cut)
@@ -363,6 +419,8 @@ class Monitor:
             return "unknown"
         self._verdicts[key] = valid
         if valid is False and self.violation is None:
+            if e is None:
+                e, init_state = enc.materialize()
             latency = max(0.0, _time.monotonic() - t_newest)
             self.violation = {
                 "detected_at_index": enc.last_index,

@@ -14,6 +14,13 @@ every chunk, so it wants one predictable engine per run):
   event-sweep formulation is itself the incremental-monitoring
   algorithm of the papers; the natural choice for CPU-only runs.
 * ``wgl`` -- the sequential oracle, for tests and tiny histories.
+* ``streamlin`` -- the device-resident incremental frontier
+  (``checker/streamlin.py``). Through THIS dispatcher it runs as a
+  one-shot fold over the whole prefix (the flat face the offline
+  equivalence tests exercise); the real O(window) streaming driver is
+  ``monitor/wgl_stream.StreamCheck``, which the monitor wires in
+  ``_encoder`` and which only reaches this function for its contained
+  flat fall-back and violation confirms.
 
 Budgets are deliberately modest: a monitor check that can't decide
 quickly returns "unknown" and the monitor moves on -- the offline
@@ -31,7 +38,7 @@ __all__ = ["ENGINES", "TXN_WORKLOADS", "check_prefix",
            "check_txn_prefix"]
 
 #: engines the monitor can drive (planlint PL013 validates against it)
-ENGINES = ("jax-wgl", "linear", "wgl")
+ENGINES = ("jax-wgl", "linear", "wgl", "streamlin")
 
 #: txn-family workloads the monitor can stream (monitor/txn.py;
 #: planlint PL025 validates against it)
@@ -59,6 +66,14 @@ def check_prefix(spec, e, init_state, engine="jax-wgl",
             from ..checker import wgl
             return wgl.check_encoded(
                 spec, e, init_state, max_configs=WGL_MAX_CONFIGS,
+                cancel=cancel)
+        if engine == "streamlin":
+            from ..checker import streamlin
+            opts = dict(engine_opts or {})
+            return streamlin.check_encoded(
+                spec, e, init_state,
+                max_configs=int(opts.get("frontier-cap")
+                                or streamlin.DEFAULT_FRONTIER_CAP),
                 cancel=cancel)
         from ..checker import jax_wgl
         opts = dict(engine_opts or {})

@@ -15,6 +15,15 @@ The ``/api/`` routes turn the viewer into checking-as-a-service
     GET  /api/campaigns/<id>  pollable status + records
     GET  /api/metrics         live Prometheus text exposition
 
+The service Coalescer ``serve`` brings up batches more than /api/check
+tenants: campaigns run on this server with a streamlin monitor route
+their per-chunk frontier folds through the same batcher, one lane per
+model (``streamlin:<model>``), so hundreds of monitored streams share
+padded device dispatches with the API traffic's containment rules
+(per-stream deadlines, solo fall-back). The lanes are observable on
+/api/metrics as ``jepsen_service_coalesce_*`` series with
+``model="streamlin:..."`` labels.
+
 API transport hardening lives here: request bodies are refused (413)
 when Content-Length exceeds ``service.MAX_BODY_BYTES`` -- BEFORE any
 read, so an adversarial body can't balloon memory -- reads are bounded

@@ -558,12 +558,13 @@ def test_jx007_shape_proliferation():
     assert "set_n_floor" in diags[0].fix_hint
     # same sizes, generous floor -> one bucket, clean
     from jepsen_tpu.campaign import compile_cache
+    prior = compile_cache.n_floor()
     compile_cache.set_n_floor(4096)
     try:
         assert not jaxlint.lint_searchplan_shapes(
             [8, 20, 40, 80, 300, 900, 2000])
     finally:
-        compile_cache.set_n_floor(1)
+        compile_cache.set_n_floor(prior)
 
 
 def test_jx007_few_shapes_clean():

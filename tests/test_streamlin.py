@@ -1,0 +1,461 @@
+"""Device-resident configuration-frontier monitoring
+(jepsen_tpu/checker/streamlin.py + jepsen_tpu/monitor/wgl_stream.py):
+incremental == offline verdict equivalence on valid and invalid
+histories across chunk sizes, the keyed split, frontier-overflow
+fall-back containment, sealed-cut carry composition, the
+prefix-length-independent dispatch/fold-cost contract, the coalescer
+lane, and planlint PL026."""
+
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import independent, store
+from jepsen_tpu import monitor as jmon
+from jepsen_tpu.analysis import planlint, sizemodel
+from jepsen_tpu.checker import linear, streamlin
+from jepsen_tpu.models import base as mbase
+from jepsen_tpu.monitor import engine as mengine
+from jepsen_tpu.monitor.wgl_stream import StreamCheck
+from jepsen_tpu.robust import ChainedLatch
+
+from test_monitor import _history
+
+SPEC = mbase.model_spec("cas-register")
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "base_dir", str(tmp_path / "store"))
+
+
+def _offline(enc_or_sc):
+    e, init = enc_or_sc.materialize()
+    return mengine.check_prefix(SPEC, e, init, engine="jax-wgl")
+
+
+def _paired(n, bad_at=None, overlap=False):
+    """n write/read rounds over 2 processes. ``overlap`` interleaves
+    the two processes' ops so checks land while ops are open (probe
+    folds) and the frontier holds >1 config."""
+    ops = []
+    val = {}
+    for i in range(n):
+        p = i % 2
+        val[p] = i + 1
+        inv_w = {"type": "invoke", "process": p, "f": "write",
+                 "value": val[p]}
+        ok_w = {"type": "ok", "process": p, "f": "write",
+                "value": val[p]}
+        rv = 999 if (bad_at is not None and i == bad_at) else val[p]
+        inv_r = {"type": "invoke", "process": p, "f": "read",
+                 "value": None}
+        ok_r = {"type": "ok", "process": p, "f": "read", "value": rv}
+        if overlap and p == 1 and ops:
+            # slide p1's invoke before p0's last completion
+            ops.insert(len(ops) - 1, inv_w)
+            ops += [ok_w, inv_r, ok_r]
+        else:
+            ops += [inv_w, ok_w, inv_r, ok_r]
+    return ops
+
+
+# ---------------------------------------------------------------------------
+# offline face: streamlin.check_encoded == linear.check_encoded
+
+
+@pytest.mark.parametrize("falsify", [None, 2, 4])
+def test_offline_face_matches_linear(falsify):
+    from jepsen_tpu import history as h
+    hist = _history(falsify_at=falsify)
+    e, st = SPEC.encode(h.index([h.Op(o) for o in hist]))
+    r_s = streamlin.check_encoded(SPEC, e, st)
+    r_l = linear.check_encoded(SPEC, e, st)
+    assert r_s["valid"] == r_l["valid"]
+    if r_s["valid"] is False:
+        assert r_s["op"]["f"] == r_l["op"]["f"]
+
+
+def test_engine_registered_and_dispatches():
+    assert "streamlin" in mengine.ENGINES
+    sc = StreamCheck(SPEC)
+    for i, op in enumerate(_history(falsify_at=4)):
+        sc.offer(op, i)
+    e, init = sc.materialize()
+    r = mengine.check_prefix(SPEC, e, init, engine="streamlin")
+    assert r["valid"] is False
+
+
+# ---------------------------------------------------------------------------
+# incremental == offline across the chunk matrix
+
+
+@pytest.mark.parametrize("chunk", [1, 8, 64])
+@pytest.mark.parametrize("falsify", [None, 4])
+def test_stream_equivalence_chunks(chunk, falsify):
+    sc = StreamCheck(SPEC)
+    verdicts = []
+    n = 0
+    for i, op in enumerate(_history(falsify_at=falsify)):
+        if sc.offer(op, i):
+            n += 1
+            if n % chunk == 0:
+                verdicts.append(sc.check()["valid"])
+    verdicts.append(sc.check()["valid"])
+    off = _offline(sc)
+    assert sc.fallback is None
+    assert verdicts[-1] == off["valid"]
+    # a violation must also have surfaced incrementally, and a valid
+    # history must never have produced a False on any chunk cut
+    assert (False in verdicts) == (off["valid"] is False)
+
+
+@pytest.mark.parametrize("chunk", [1, 8])
+@pytest.mark.parametrize("falsify", [None, 4])
+def test_monitor_streamlin_end_to_end(chunk, falsify):
+    latch = ChainedLatch()
+    mon = jmon.Monitor(SPEC, latch, chunk=chunk,
+                       engine="streamlin").start()
+    for op in _history(falsify_at=falsify):
+        mon.offer(op)
+    mon.stop()
+    s = mon.summary()
+    assert s["verdict"] == (falsify is None)
+    assert latch.is_set() == (falsify is not None)
+    st = s.get("stream")
+    assert st is not None and "fallback" not in st
+    if falsify is not None:
+        assert s["detected_at_index"] >= 0
+
+
+def test_stream_probe_path_open_ops():
+    """Checks landing while ops are open exercise the probe fold (the
+    sealed frontier is extended speculatively and discarded); verdicts
+    still match offline at every cut."""
+    sc = StreamCheck(SPEC)
+    probes_hit = False
+    for i, op in enumerate(_paired(30, overlap=True)):
+        sc.offer(op, i)
+        if op["type"] == "invoke" and i % 7 == 0:
+            r = sc.check()
+            assert r["valid"] is True
+        probes_hit = probes_hit or sc.probe_folds > 0
+    assert probes_hit
+    assert sc.check()["valid"] is _offline(sc)["valid"] is True
+
+
+# ---------------------------------------------------------------------------
+# keyed split
+
+
+def test_keyed_streams_streamlin():
+    t = independent.tuple_
+    ops = []
+    for k in ("a", "b"):
+        ops += [
+            {"type": "invoke", "process": 0, "f": "write",
+             "value": t(k, 1)},
+            {"type": "ok", "process": 0, "f": "write", "value": t(k, 1)},
+            {"type": "invoke", "process": 1, "f": "read",
+             "value": t(k, None)},
+            {"type": "ok", "process": 1, "f": "read",
+             "value": t(k, 1 if k == "a" else 42)},
+        ]
+    latch = ChainedLatch()
+    mon = jmon.Monitor(SPEC, latch, chunk=1, engine="streamlin",
+                       keyed=True).start()
+    for op in ops:
+        mon.offer(op)
+    mon.stop()
+    s = mon.summary()
+    assert s["verdict"] is False
+    assert s["key"] == "b"
+    assert s["keys"] == 2
+    # per-key stream blocks aggregated: counters sum, sizes max
+    assert s["stream"]["checks"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# containment: overflow falls back, never flips
+
+
+def test_frontier_overflow_falls_back_contained():
+    """frontier-cap 1 cannot hold two overlapping writes' configs: the
+    stream must degrade to flat re-checks and keep returning the
+    offline verdict (containment: overflow is a cost, never a flip)."""
+    for falsify in (None, 3):
+        sc = StreamCheck(SPEC, opts={"frontier-cap": 1})
+        final = None
+        for i, op in enumerate(_paired(8, bad_at=falsify,
+                                       overlap=True)):
+            sc.offer(op, i)
+            if op["type"] != "invoke" and i % 5 == 0:
+                final = sc.check()["valid"]
+                if final is False:
+                    break
+        if final is not False:
+            final = sc.check()["valid"]
+        assert sc.fallback is not None or sc.flat_checks > 0 \
+            or sc.probe_overflows > 0
+        assert final == _offline(sc)["valid"]
+
+
+def test_violation_confirmed_offline():
+    """A frontier False is a suspicion: the offline engine owns the
+    verdict of record (detected_by marks the stream's find)."""
+    sc = StreamCheck(SPEC)
+    r = None
+    for i, op in enumerate(_history(falsify_at=4)):
+        if sc.offer(op, i):
+            r = sc.check()
+            if r["valid"] is False:
+                break
+    assert r is not None and r["valid"] is False
+    assert r.get("detected_by") == "streamlin"
+    assert sc.confirm_mismatches == 0
+
+
+def test_dynamic_state_size_degrades_to_flat():
+    """A model whose state size needs the history (queues) can't keep
+    a fixed-width frontier: the stream must run flat from the start
+    and still verdict correctly."""
+    qspec = mbase.model_spec("fifo-queue")
+    sc = StreamCheck(qspec)
+    assert sc.fallback == "dynamic-state-size"
+    ops = [{"type": "invoke", "process": 0, "f": "enqueue", "value": 1},
+           {"type": "ok", "process": 0, "f": "enqueue", "value": 1},
+           {"type": "invoke", "process": 0, "f": "dequeue",
+            "value": None},
+           {"type": "ok", "process": 0, "f": "dequeue", "value": 1}]
+    for i, op in enumerate(ops):
+        sc.offer(op, i)
+    assert sc.check()["valid"] is True
+    assert sc.flat_checks == 1
+
+
+# ---------------------------------------------------------------------------
+# sealed-cut carry composition (PR 7)
+
+
+def test_sealed_cut_carry_composes():
+    """truncate_before on the stream encoder (the monitor's quiescent
+    carry) bounds the FLAT fall-back's materialized prefix; the device
+    frontier carries independently, and verdicts stay offline-equal
+    after a truncation."""
+    from jepsen_tpu.analysis import searchplan
+    sc = StreamCheck(SPEC)
+    i = 0
+    for op in _paired(12):
+        sc.offer(op, i)
+        i += 1
+    assert sc.check()["valid"] is True
+    e, _ = sc.materialize()
+    cut = searchplan.stream_cut(SPEC, e)
+    assert cut is not None
+    dropped = sc.truncate_before(*cut)
+    assert dropped > 0
+    n_after_cut = len(sc)
+    # stream on: a later violation is still caught, and the confirm
+    # path (offline over the TRUNCATED prefix) agrees
+    for op in _paired(6, bad_at=3):
+        sc.offer(op, i)
+        i += 1
+    r = sc.check()
+    assert r["valid"] is False
+    assert _offline(sc)["valid"] is False
+    assert len(sc) < n_after_cut + 6 * 2 + 1  # carry actually bounded
+
+
+def test_monitor_quiescent_carry_with_streamlin():
+    """Through the Monitor: carry on, engine streamlin -- truncations
+    happen on True verdicts and the final verdict still lands."""
+    latch = ChainedLatch()
+    mon = jmon.Monitor(SPEC, latch, chunk=4, engine="streamlin",
+                       quiescent_carry=True).start()
+    for op in _paired(40):
+        mon.offer(op)
+    mon.stop()
+    s = mon.summary()
+    assert s["verdict"] is True
+    assert s.get("quiescent_truncated_ops", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# the O(window) contract: dispatch count + fold cost independent of
+# prefix length
+
+
+def test_fold_cost_independent_of_prefix():
+    sc = StreamCheck(SPEC)
+    per_check = []   # (fold dispatches, fold cells) per chunk check
+    n = 0
+    for i, op in enumerate(_paired(120)):
+        sc.offer(op, i)
+        if op["type"] != "invoke":
+            n += 1
+            if n % 8 == 0:
+                d0 = sc.solo_folds + sc.coalesced_folds
+                c0 = sc.fold_cells
+                assert sc.check()["valid"] is True
+                per_check.append(
+                    (sc.solo_folds + sc.coalesced_folds - d0,
+                     sc.fold_cells - c0))
+    assert sc.fallback is None and sc.flat_checks == 0
+    assert len(per_check) >= 20
+    dispatches = [d for d, _ in per_check]
+    cells = [c for _, c in per_check]
+    # dispatch count: a small constant per chunk (seal + probe + at
+    # most a grow retry), NEVER growing with the consumed prefix
+    assert max(dispatches) <= 3
+    # fold cost: the last checks sweep no more cells than the early
+    # ones did (the prefix grew 15x; an O(prefix) engine can't pass)
+    early = max(cells[2:6])
+    late = max(cells[-4:])
+    assert late <= early, (early, late)
+    # and the window itself never grew past its floor on this
+    # well-behaved stream
+    assert sc.NW == streamlin.WINDOW_FLOOR
+    assert sc.sealed_rows > 0  # slots actually recycle
+
+
+# ---------------------------------------------------------------------------
+# coalescer lane: strangers' streams share a dispatch
+
+
+def test_streams_coalesce_across_owners():
+    from jepsen_tpu.fleet import service as fsvc
+    co = fsvc.configure_coalesce(enabled=True, window_ms=40)
+    try:
+        out = {}
+
+        def run(tag):
+            sc = StreamCheck(SPEC, owner=f"t{tag}")
+            n = 0
+            for i, op in enumerate(_history()):
+                if sc.offer(op, i):
+                    n += 1
+                    if n % 4 == 0:
+                        sc.check()
+                time.sleep(0.002)
+            out[tag] = (sc.check(), sc)
+
+        ts = [threading.Thread(target=run, args=(t,)) for t in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(r["valid"] is True for r, _ in out.values())
+        assert sum(sc.coalesced_folds for _, sc in out.values()) > 0
+        stats = co.stats()
+        assert stats["batches"] > 0
+        assert stats["segments"] > stats["batches"]  # real sharing
+    finally:
+        fsvc.configure_coalesce(enabled=False)
+
+
+def test_solo_fallback_without_coalescer():
+    """No service batcher: folds run solo, verdicts unchanged."""
+    sc = StreamCheck(SPEC)  # coalesce on, but no coalescer configured
+    for i, op in enumerate(_history()):
+        sc.offer(op, i)
+    assert sc.check()["valid"] is True
+    assert sc.coalesced_folds == 0 and sc.solo_folds > 0
+
+
+def test_batch_fold_mixed_shapes_regroup():
+    """batch_fold must regroup by full tensor shape: members whose
+    frontiers grew mid-flight can never mis-stack."""
+    def job_for(hist):
+        sc = StreamCheck(SPEC, opts={"coalesce?": False})
+        for i, op in enumerate(hist):
+            sc.offer(op, i)
+        sc._ensure_committed()
+        ev = sorted(sc._pending, key=lambda e: (e[0], e[1]))
+        if sc._dirty:
+            d, sc._dirty = sc._dirty, {}
+            sc._upload(d)
+        import numpy as np
+        E = streamlin.EVENT_FLOOR
+        ek = np.zeros(E, np.int32)
+        es = np.zeros(E, np.int32)
+        for k, (_t, kind, row) in enumerate(ev):
+            ek[k] = kind
+            es[k] = sc._slot_by_row[id(row)]
+        lin_, st, live, open_w = sc._committed
+        w_f, w_args, w_ret = sc._window
+        return streamlin.FoldJob(SPEC, sc.C, {
+            "lin": lin_, "st": st, "live": live, "open_w": open_w,
+            "ev_kind": ek, "ev_slot": es, "w_f": w_f,
+            "w_args": w_args, "w_ret": w_ret,
+            "clear_w": np.zeros(lin_.shape[1], np.uint32)}, len(ev))
+
+    jobs = [job_for(_history()), job_for(_history(falsify_at=4)),
+            job_for(_history())]
+    results = streamlin.batch_fold(jobs, owners=["a", "b", "c"])
+    assert len(results) == 3
+    assert results[0]["status"] == 0
+    assert results[1]["status"] == 1   # the falsified member, alone
+    assert results[2]["status"] == 0
+
+
+# ---------------------------------------------------------------------------
+# planlint PL026 + sizemodel registration
+
+
+def test_pl026_stream_knobs():
+    bad_cap = {"monitor": {"engine": "streamlin",
+                           "engine-opts": {"frontier-cap": 0}}}
+    codes = [d for d in planlint.monitor_diags(bad_cap)
+             if d.code == "PL026"]
+    assert codes and codes[0].severity == "error"
+
+    over = {"monitor": {"engine": "streamlin",
+                        "engine-opts": {
+                            "frontier-cap":
+                                streamlin.FRONTIER_CAP_MAX * 2}}}
+    assert any(d.code == "PL026" and d.severity == "error"
+               for d in planlint.monitor_diags(over))
+
+    carry_off = {"monitor": {"engine": "streamlin",
+                             "quiescent-carry?": False}}
+    diags = [d for d in planlint.monitor_diags(carry_off)
+             if d.code == "PL026"]
+    assert diags and diags[0].severity == "warning"
+
+    from jepsen_tpu.checker import checkers as cks
+    no_gate = {"monitor": {"engine": "streamlin"},
+               "checker": cks.stats()}
+    assert any(d.code == "PL026" and d.severity == "error"
+               for d in planlint.monitor_diags(no_gate))
+
+    clean = {"monitor": {"engine": "streamlin"}}
+    assert not [d for d in planlint.monitor_diags(clean)
+                if d.code == "PL026"]
+
+
+def test_sizemodel_stream_frontier_shape():
+    sh = sizemodel.stream_frontier_shape(4096, 4096)
+    assert sh["model"] == "streamlin"
+    assert sh["bucket"] == 4096
+    assert sh["hbm"]["total"] > 0
+    assert sh["fold_cells"] > 0
+    # ledger projection: solo and batch keys land on the pseudo-model
+    k = ("cas-register", 1, 64, 2, 1, 8, 64, 2)
+    assert sizemodel.ledger_key_shape("streamlin", k) \
+        == ("streamlin", 64)
+    kb = ("cas-register", 8, 64, 2, 1, 8, 64, 2)
+    assert sizemodel.ledger_key_shape("streamlin-batch", kb) \
+        == ("streamlin", 64)
+
+
+def test_capplan_quotes_stream_frontier():
+    from jepsen_tpu.analysis import capplan
+    cell = {"workload": "register", "time-limit": 5, "rate": 10,
+            "concurrency": 2,
+            "monitor": {"engine": "streamlin"}}
+    models = [s["model"] for s in capplan.shapes_for_cell(cell)]
+    assert "streamlin" in models
+    cell.pop("monitor")
+    models = [s["model"] for s in capplan.shapes_for_cell(cell)]
+    assert "streamlin" not in models
